@@ -1,0 +1,12 @@
+"""cst_captioning_tpu — TPU-native consensus-based sequence training for video captioning.
+
+A ground-up JAX/XLA/Flax rebuild of the capabilities of
+``Tsingzao/cst_captioning`` (arXiv:1712.09532): HDF5 multimodal feature
+pipeline, Flax encoder + LSTM/Transformer caption decoders, XE → weighted-XE
+→ CST/REINFORCE training with CIDEr-D consensus rewards, XLA-compiled
+greedy/multinomial/beam decoding, pure-Python metric stack, and
+``shard_map`` data parallelism over a TPU mesh.  See SURVEY.md for the
+blueprint and provenance notes.
+"""
+
+__version__ = "0.1.0"

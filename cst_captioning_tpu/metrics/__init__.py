@@ -1,0 +1,37 @@
+"""Pure-Python metric stack: tokenizer, CIDEr-D reward, BLEU/METEOR/ROUGE eval.
+
+Replaces the reference's vendored ``cider/`` + ``coco-caption/`` packages and
+their Java subprocesses (SURVEY.md §2, §3.4) with in-process implementations.
+"""
+
+from .bleu import compute_bleu
+from .ciderd import CiderD, build_corpus_df, load_corpus_df, save_corpus_df
+from .coco_eval import language_eval, load_cocofmt_refs
+from .consensus import (
+    compute_consensus_scores,
+    load_consensus,
+    normalize_weights,
+    save_consensus,
+)
+from .meteor import compute_meteor
+from .rouge import compute_rouge
+from .tokenizer import tokenize, tokenize_corpus, tokenize_to_str
+
+__all__ = [
+    "CiderD",
+    "build_corpus_df",
+    "compute_bleu",
+    "compute_consensus_scores",
+    "compute_meteor",
+    "compute_rouge",
+    "language_eval",
+    "load_cocofmt_refs",
+    "load_consensus",
+    "load_corpus_df",
+    "normalize_weights",
+    "save_consensus",
+    "save_corpus_df",
+    "tokenize",
+    "tokenize_corpus",
+    "tokenize_to_str",
+]

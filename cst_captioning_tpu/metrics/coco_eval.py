@@ -1,0 +1,78 @@
+"""``language_eval`` — the metric-suite orchestrator, no Java, no subprocess.
+
+Reimplements the reference's ``utils.language_eval`` →
+``COCOEvalCap.evaluate()`` stack (SURVEY.md §3.4) as a single in-process
+call: PTB-style tokenization of hypotheses and references, then
+BLEU-1..4, METEOR (pure-Python approximation), ROUGE-L, CIDEr and CIDEr-D.
+Accepts coco-format annotation/result structures so prediction JSONs written
+by ``eval.py`` score identically to the reference workflow.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .bleu import compute_bleu
+from .ciderd import CiderD
+from .meteor import compute_meteor
+from .rouge import compute_rouge
+from .tokenizer import tokenize_corpus
+
+
+def load_cocofmt_refs(cocofmt_file: str) -> Dict[str, List[str]]:
+    """Read a coco-format annotations JSON into {image_id: [caption, ...]}."""
+    with open(cocofmt_file) as f:
+        coco = json.load(f)
+    refs: Dict[str, List[str]] = {}
+    for ann in coco["annotations"]:
+        refs.setdefault(str(ann["image_id"]), []).append(ann["caption"])
+    return refs
+
+
+def language_eval(
+    predictions: Sequence[Mapping[str, object]],
+    refs: Mapping[str, Sequence[str]] | str,
+    scorers: Optional[Sequence[str]] = None,
+) -> Dict[str, float]:
+    """Score predictions [{"image_id": id, "caption": text}, ...].
+
+    ``refs`` is either {image_id: [caption,...]} or a path to a coco-format
+    annotations JSON.  Only image_ids present in ``predictions`` are scored
+    (matching COCOEvalCap, which evaluates on the result set).  Returns the
+    printed metric dict the reference workflow produces.
+    """
+    if isinstance(refs, str):
+        refs = load_cocofmt_refs(refs)
+    res_raw = {str(p["image_id"]): [str(p["caption"])] for p in predictions}
+    gts_raw = {k: list(refs[k]) for k in res_raw.keys() if k in refs}
+    missing = set(res_raw) - set(gts_raw)
+    if missing:
+        raise KeyError(f"predictions for ids without references: {sorted(missing)[:5]}")
+    res = tokenize_corpus(res_raw)
+    gts = tokenize_corpus(gts_raw)
+
+    if scorers is None:
+        scorers = ("Bleu", "METEOR", "ROUGE_L", "CIDEr")
+    out: Dict[str, float] = {}
+    if "Bleu" in scorers:
+        bleus, _ = compute_bleu(gts, res, n=4)
+        for i, b in enumerate(bleus, 1):
+            out[f"Bleu_{i}"] = float(b)
+    if "METEOR" in scorers:
+        out["METEOR"] = compute_meteor(gts, res)[0]
+    if "ROUGE_L" in scorers:
+        out["ROUGE_L"] = compute_rouge(gts, res)[0]
+    res_list = [{"image_id": k, "caption": v} for k, v in res.items()]
+    if "CIDEr" in scorers:
+        # coco-caption's Cider scorer carries count clipping and the gaussian
+        # length penalty (CIDEr-D semantics) despite its name; published
+        # "CIDEr" columns are that metric, so the eval key must match it.
+        out["CIDEr"] = CiderD(df_mode="refs", variant="cider-d").compute_score(gts, res_list)[0]
+    if "CIDEr-plain" in scorers:
+        # The un-clipped, no-length-penalty original formulation, kept for
+        # completeness (pyciderevalcap ships it as its `Cider` class).
+        out["CIDEr-plain"] = CiderD(df_mode="refs", variant="cider").compute_score(gts, res_list)[0]
+    return out

@@ -1,0 +1,87 @@
+"""Consensus CIDEr-D scores — the CST paper's core offline artifact.
+
+Powers two things (SURVEY.md §2 "CLI config" / §7 hard part (d)):
+
+1. **WXE weights** (``--train_bcmrscores_pkl`` in the reference CLI): each
+   ground-truth caption is scored with CIDEr-D against its sibling
+   references for the same video (leave-one-out).  During weighted-XE
+   training that scalar multiplies the caption's loss so high-consensus
+   captions dominate.
+
+2. **SCB baseline** (self-consensus baseline): during REINFORCE, instead of
+   a greedy-decode baseline, the advantage baseline for a video is the mean
+   consensus score of (a subset of) its reference captions — precomputed
+   here, indexed at train time.
+
+Leave-one-out semantics: caption j of video v is scored against the other
+captions of v (never itself), with document frequencies from the full
+training corpus so the numbers live on the same scale as RL rewards.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Mapping, Sequence
+
+import numpy as np
+
+from .ciderd import CiderD, build_corpus_df
+
+
+def compute_consensus_scores(
+    tokenized_refs: Mapping[str, Sequence[str]],
+    n: int = 4,
+    sigma: float = 6.0,
+) -> Dict[str, np.ndarray]:
+    """Leave-one-out CIDEr-D of every reference caption vs its siblings.
+
+    Returns {video_id: float array of shape (num_captions,)} in the same
+    caption order as the input.
+    """
+    df, ndocs = build_corpus_df(tokenized_refs, n)
+    scorer = CiderD(n=n, sigma=sigma, df_mode="corpus", df=df, ref_len=float(ndocs))
+    out: Dict[str, np.ndarray] = {}
+    for vid, caps in tokenized_refs.items():
+        caps = list(caps)
+        if len(caps) == 1:
+            out[vid] = np.zeros(1)
+            continue
+        gts = {}
+        res = []
+        for j, c in enumerate(caps):
+            key = f"{vid}#{j}"
+            gts[key] = [caps[i] for i in range(len(caps)) if i != j]
+            res.append({"image_id": key, "caption": [c]})
+        _, scores = scorer.compute_score(gts, res)
+        out[vid] = scores
+    return out
+
+
+def normalize_weights(
+    scores: Mapping[str, np.ndarray], temperature: float = 1.0
+) -> Dict[str, np.ndarray]:
+    """Turn raw consensus scores into per-video softmax weights for WXE.
+
+    The CST paper weights each caption's XE loss by a normalized consensus
+    score; softmax-with-temperature over each video's caption set keeps the
+    per-video total loss mass constant (so WXE and XE losses are on the same
+    scale and learning rates transfer between stages).
+    """
+    out = {}
+    for vid, s in scores.items():
+        z = np.asarray(s, dtype=np.float64) / max(temperature, 1e-8)
+        z = z - z.max()
+        e = np.exp(z)
+        out[vid] = (e / e.sum()) * len(s)   # mean weight == 1
+    return out
+
+
+def save_consensus(path: str, scores: Mapping[str, np.ndarray]) -> None:
+    with open(path, "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in scores.items()}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_consensus(path: str) -> Dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,34 @@
+"""n-gram cooking shared by CIDEr-D, BLEU and the consensus builders.
+
+The reference's vendored ``pyciderevalcap``/``pycocoevalcap`` each carry a
+private copy of precook/cook_refs/cook_test; here there is a single
+implementation.  Captions are pre-tokenized strings ("a man is cooking"),
+n-grams are tuples of tokens, counts are plain dicts.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Sequence, Tuple
+
+NGram = Tuple[str, ...]
+NGramCounts = Dict[NGram, int]
+
+
+def precook(caption: str, n: int = 4) -> NGramCounts:
+    """Count all k-grams for k in 1..n of a whitespace-tokenized caption."""
+    words = caption.split()
+    counts: NGramCounts = defaultdict(int)
+    for k in range(1, n + 1):
+        for i in range(len(words) - k + 1):
+            counts[tuple(words[i : i + k])] += 1
+    return dict(counts)
+
+
+def cook_refs(refs: Sequence[str], n: int = 4) -> List[NGramCounts]:
+    """Cook each reference caption of one video independently."""
+    return [precook(r, n) for r in refs]
+
+
+def cook_test(test: str, n: int = 4) -> NGramCounts:
+    return precook(test, n)

@@ -1,0 +1,112 @@
+"""PTB-style caption tokenizer — pure Python, no Java subprocess.
+
+The reference pipeline (cst_captioning's vendored ``coco-caption``) shells out
+to the Stanford CoreNLP ``PTBTokenizer`` jar before every metric computation,
+then drops a fixed punctuation list.  (Reference mount was empty at survey
+time — see SURVEY.md provenance warning; behavior reconstructed from the
+public pycocoevalcap package the reference vendors.)
+
+This module reimplements that normalization as a single pass of compiled
+regexes so the metric stack is a pure-Python process with no JVM, tempfiles,
+or subprocess pipes.  The observable contract is:
+
+    tokenize(caption) -> list of lowercase word tokens with PTB-style
+    splitting applied and the coco-caption punctuation set removed.
+
+Caption text in MSR-VTT / MSVD / ActivityNet annotations is simple
+(lowercase-ish English sentences), so the PTB rules that matter here are:
+contraction splitting (``don't`` -> ``do n't``), possessives
+(``dog's`` -> ``dog 's``), punctuation isolation, and bracket
+normalization.  All punctuation is subsequently dropped, matching
+coco-caption's PUNCTUATIONS list, so edge-case differences in *how* a
+punctuation mark was split cannot affect metric values — only mis-splitting
+of word-internal apostrophes could, and those cases are covered by tests.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List
+
+# coco-caption's PTBTokenizer wrapper removes exactly these tokens after
+# the Java tokenizer runs.
+PUNCTUATIONS = frozenset(
+    [
+        "''", "'", "``", "`",
+        "-LRB-", "-RRB-", "-LCB-", "-RCB-",
+        ".", "?", "!", ",", ":", "-", "--", "...", ";",
+    ]
+)
+
+# PTB splits these contraction suffixes off the host word.
+_CONTRACTIONS = re.compile(r"(?i)([a-z])('ll|'re|'ve|n't|'s|'m|'d)\b")
+# Words PTB splits in the middle (cannot, gonna, ...).
+_SPECIAL_SPLITS = {
+    "cannot": ("can", "not"),
+    "gonna": ("gon", "na"),
+    "gotta": ("got", "ta"),
+    "wanna": ("wan", "na"),
+    "lemme": ("lem", "me"),
+    "gimme": ("gim", "me"),
+    "d'ye": ("d'", "ye"),
+    "'tis": ("'t", "is"),
+    "'twas": ("'t", "was"),
+}
+_BRACKETS = {
+    "(": "-LRB-", ")": "-RRB-",
+    "{": "-LCB-", "}": "-RCB-",
+    "[": "-LRB-", "]": "-RRB-",
+}
+# Isolate punctuation / symbols. Ellipsis and -- first so they stay whole.
+_PUNCT_ISOLATE = re.compile(r"(\.\.\.|--|[,;:@#$%&?!\"(){}\[\]<>=+/\\*^~|])")
+# Abbreviations like "u.s." keep their periods (PTB treats them as one token);
+# any other token-trailing period is sentence-terminal and is split off.
+_ABBREV = re.compile(r"^([a-z]\.)+$", re.IGNORECASE)
+# Contraction suffixes PTB emits as their own (kept) tokens — exempt from
+# apostrophe stripping below.
+_CONTRACTION_TOKENS = frozenset(["'s", "'re", "'ve", "'ll", "'m", "'d", "n't", "'t"])
+
+
+def tokenize(caption: str) -> List[str]:
+    """Tokenize one caption string into normalized word tokens."""
+    s = caption.replace("\n", " ").replace("—", " -- ").replace("–", " -- ").strip()
+    s = _PUNCT_ISOLATE.sub(r" \1 ", s)
+    s = _CONTRACTIONS.sub(r"\1 \2", s)
+    out: List[str] = []
+    for tok in s.split():
+        low = tok.lower()
+        if low in _SPECIAL_SPLITS:
+            out.extend(_SPECIAL_SPLITS[low])
+            continue
+        # Sentence-terminal period: split off unless abbreviation-shaped.
+        if tok.endswith(".") and tok.strip(".") and not _ABBREV.match(tok):
+            tok = tok[:-1]
+        # Bare surrounding apostrophes ('hello', dogs') are quote characters
+        # PTB renders as `/''; strip them — but keep contraction tokens.
+        if tok.lower() not in _CONTRACTION_TOKENS:
+            tok = tok.strip("'")
+        if not tok:
+            continue
+        tok = _BRACKETS.get(tok, tok)
+        low = tok.lower()
+        if tok in PUNCTUATIONS or low in PUNCTUATIONS or low == '"':
+            continue
+        out.append(low)
+    return out
+
+
+def tokenize_to_str(caption: str) -> str:
+    """Tokenize and re-join with single spaces (the form metrics consume)."""
+    return " ".join(tokenize(caption))
+
+
+def tokenize_corpus(captions_for_key: Dict[str, Iterable[str]]) -> Dict[str, List[str]]:
+    """Tokenize a ``{key: [caption, ...]}`` mapping (coco-caption's interface).
+
+    Returns ``{key: [tokenized_caption_str, ...]}`` preserving order, which is
+    the exact shape PTBTokenizer.tokenize() returned to COCOEvalCap.
+    """
+    return {
+        key: [tokenize_to_str(c) for c in caps]
+        for key, caps in captions_for_key.items()
+    }

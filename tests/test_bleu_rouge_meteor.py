@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.metrics.bleu import compute_bleu
+from cst_captioning_tpu.metrics.meteor import compute_meteor, meteor_segment
+from cst_captioning_tpu.metrics.rouge import compute_rouge, rouge_l_segment, _lcs_len
+
+
+GTS = {
+    "a": ["the cat sat on the mat", "a cat is sitting on a mat"],
+    "b": ["a man rides a horse", "the man is riding a horse"],
+}
+
+
+class TestBleu:
+    def test_perfect_match(self):
+        res = {"a": ["the cat sat on the mat"], "b": ["a man rides a horse"]}
+        bleus, _ = compute_bleu(GTS, res)
+        for b in bleus:
+            assert b == pytest.approx(1.0, abs=1e-6)
+
+    def test_orders_decreasing_for_partial(self):
+        res = {"a": ["the cat sat on a chair"], "b": ["a man rides a bike"]}
+        bleus, _ = compute_bleu(GTS, res)
+        assert bleus[0] > bleus[3]
+        assert all(0.0 <= b <= 1.0 for b in bleus)
+
+    def test_brevity_penalty(self):
+        full = {"a": ["the cat sat on the mat"], "b": ["a man rides a horse"]}
+        clipped = {"a": ["the cat"], "b": ["a man"]}
+        b_full, _ = compute_bleu(GTS, full)
+        b_clip, _ = compute_bleu(GTS, clipped)
+        assert b_clip[0] < b_full[0]
+
+    def test_no_overlap_near_zero(self):
+        res = {"a": ["zz qq ww"], "b": ["xx yy vv"]}
+        bleus, _ = compute_bleu(GTS, res)
+        assert bleus[0] < 1e-3
+
+
+class TestRouge:
+    def test_lcs(self):
+        assert _lcs_len("a b c d".split(), "a c d".split()) == 3
+        assert _lcs_len([], ["a"]) == 0
+
+    def test_perfect(self):
+        assert rouge_l_segment("a man rides a horse", ["a man rides a horse"]) == pytest.approx(1.0)
+
+    def test_partial_between_0_1(self):
+        s = rouge_l_segment("a man rides", ["a man rides a horse"])
+        assert 0.0 < s < 1.0
+
+    def test_corpus_mean(self):
+        res = {"a": ["the cat sat on the mat"], "b": ["a man walks"]}
+        mean, scores = compute_rouge(GTS, res)
+        assert mean == pytest.approx(scores.mean())
+        assert scores[0] == pytest.approx(1.0)
+
+
+class TestMeteor:
+    def test_perfect(self):
+        s = meteor_segment("a man rides a horse", ["a man rides a horse"])
+        # single chunk → penalty = gamma * 1^beta? chunks/m = 1/5 → small penalty
+        assert s > 0.9
+
+    def test_stem_matching(self):
+        # "riding" should stem-match "rides"... both stem to "ride"/"rid".
+        s = meteor_segment("the man riding a horse", ["the man rides a horse"])
+        assert s > 0.6
+
+    def test_word_order_penalty(self):
+        ordered = meteor_segment("a man rides a horse", ["a man rides a horse"])
+        shuffled = meteor_segment("horse a rides man a", ["a man rides a horse"])
+        assert ordered > shuffled
+
+    def test_no_match(self):
+        assert meteor_segment("zz qq", ["a man rides"]) == 0.0
+
+    def test_corpus(self):
+        res = {"a": ["the cat sat on the mat"], "b": ["a man rides a horse"]}
+        mean, scores = compute_meteor(GTS, res)
+        assert mean == pytest.approx(scores.mean())
+        assert all(s > 0.9 for s in scores)
+
+
+def test_porter_e_restoration():
+    from cst_captioning_tpu.metrics.meteor import _porter_stem
+    assert _porter_stem("riding") == _porter_stem("rides") == _porter_stem("ride")
+    assert _porter_stem("making") == _porter_stem("makes") == _porter_stem("make")
+    assert _porter_stem("cooking") == _porter_stem("cooks")
+    assert _porter_stem("running") == _porter_stem("runs")
+    assert _porter_stem("playing") == _porter_stem("plays")

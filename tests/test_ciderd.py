@@ -1,0 +1,139 @@
+import math
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.metrics.ciderd import (
+    CiderD,
+    build_corpus_df,
+    load_corpus_df,
+    save_corpus_df,
+)
+from cst_captioning_tpu.metrics.ngrams import precook
+
+
+def make_scorer(refs):
+    df, n = build_corpus_df(refs)
+    return CiderD(df_mode="corpus", df=df, ref_len=float(n))
+
+
+CORPUS = {
+    "v1": ["a man is cooking food", "a man cooks in a kitchen", "someone is cooking"],
+    "v2": ["a dog runs in a park", "the dog is running outside", "a dog runs fast"],
+    "v3": ["a woman sings a song", "the woman is singing", "a lady sings on stage"],
+    "v4": ["kids play soccer", "children are playing football", "boys play a ball game"],
+}
+
+
+def test_precook_counts():
+    c = precook("a a b")
+    assert c[("a",)] == 2 and c[("b",)] == 1 and c[("a", "a")] == 1 and c[("a", "b")] == 1
+
+
+def test_exact_match_scores_high():
+    s = make_scorer(CORPUS)
+    res = [{"image_id": "v1", "caption": ["a man is cooking food"]}]
+    _, scores = s.compute_score(CORPUS, res)
+    # Identical to one ref → strong but <10 (averaged over 3 refs).
+    assert scores[0] > 2.0
+
+
+def test_disjoint_scores_zero():
+    s = make_scorer(CORPUS)
+    res = [{"image_id": "v1", "caption": ["purple elephants juggle quantum physics"]}]
+    _, scores = s.compute_score(CORPUS, res)
+    assert scores[0] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_better_match_scores_higher():
+    s = make_scorer(CORPUS)
+    good = [{"image_id": "v1", "caption": ["a man is cooking"]}]
+    weak = [{"image_id": "v1", "caption": ["a man walks"]}]
+    _, g = s.compute_score(CORPUS, good)
+    _, w = s.compute_score(CORPUS, weak)
+    assert g[0] > w[0]
+
+
+def test_repetition_clipped():
+    # CIDEr-D's clipping: repeating a matched word must not inflate score.
+    s = make_scorer(CORPUS)
+    normal = [{"image_id": "v2", "caption": ["a dog runs"]}]
+    stutter = [{"image_id": "v2", "caption": ["a dog dog dog dog runs"]}]
+    _, ns = s.compute_score(CORPUS, normal)
+    _, ss = s.compute_score(CORPUS, stutter)
+    assert ns[0] > ss[0]
+
+
+def test_length_penalty():
+    # Same content, padded with off-corpus tokens → gaussian length penalty bites.
+    s = make_scorer(CORPUS)
+    short = [{"image_id": "v2", "caption": ["a dog runs fast"]}]
+    long = [{"image_id": "v2", "caption": ["a dog runs fast " + "zz " * 12]}]
+    _, sh = s.compute_score(CORPUS, short)
+    _, lo = s.compute_score(CORPUS, long)
+    assert sh[0] > lo[0]
+
+
+def test_idf_downweights_common_ngrams():
+    # "a" appears in every doc (df=4) → idf 0; a content word appears once → positive.
+    df, n = build_corpus_df(CORPUS)
+    assert df[("a",)] == 4.0
+    assert df[("soccer",)] == 1.0
+    log_ref = math.log(4.0)
+    assert log_ref - math.log(max(df[("a",)], 1.0)) == pytest.approx(0.0)
+
+
+def test_batch_order_preserved():
+    s = make_scorer(CORPUS)
+    res = [
+        {"image_id": "v1", "caption": ["a man is cooking"]},
+        {"image_id": "v2", "caption": ["a dog runs"]},
+        {"image_id": "v1", "caption": ["purple elephants juggle"]},
+    ]
+    mean, scores = s.compute_score(CORPUS, res)
+    assert len(scores) == 3
+    assert scores[2] < scores[0]
+    assert mean == pytest.approx(scores.mean())
+
+
+def test_df_pickle_roundtrip(tmp_path):
+    df, n = build_corpus_df(CORPUS)
+    p = str(tmp_path / "df.pkl")
+    save_corpus_df(p, df, n)
+    df2, ref_len = load_corpus_df(p)
+    assert df2 == df and ref_len == float(n)
+    s1 = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    s2 = CiderD(df_mode="corpus", df_path=p)
+    res = [{"image_id": "v3", "caption": ["a woman sings"]}]
+    assert s1.compute_score(CORPUS, res)[1][0] == pytest.approx(
+        s2.compute_score(CORPUS, res)[1][0]
+    )
+
+
+def test_refs_mode_matches_manual_corpus():
+    s_corpus = make_scorer(CORPUS)
+    s_refs = CiderD(df_mode="refs")
+    res = [{"image_id": "v4", "caption": ["kids play football"]}]
+    a = s_corpus.compute_score(CORPUS, res)[1][0]
+    b = s_refs.compute_score(CORPUS, res)[1][0]
+    assert a == pytest.approx(b)
+
+
+def test_plain_cider_variant():
+    # Plain CIDEr: no clipping, no length penalty — stutter & padding hurt
+    # less than under CIDEr-D, and matched content scores at least as high.
+    d = CiderD(df_mode="refs", variant="cider-d")
+    c = CiderD(df_mode="refs", variant="cider")
+    long = [{"image_id": "v2", "caption": ["a dog runs fast " + "zz " * 12]}]
+    _, d_long = d.compute_score(CORPUS, long)
+    _, c_long = c.compute_score(CORPUS, long)
+    assert c_long[0] > d_long[0]          # no gaussian penalty
+    exact = [{"image_id": "v2", "caption": ["a dog runs fast"]}]
+    _, d_e = d.compute_score(CORPUS, exact)
+    _, c_e = c.compute_score(CORPUS, exact)
+    assert c_e[0] >= d_e[0] - 1e-9
+
+
+def test_variant_validation():
+    with pytest.raises(ValueError):
+        CiderD(df_mode="refs", variant="bogus")

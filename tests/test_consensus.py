@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.metrics.consensus import (
+    compute_consensus_scores,
+    load_consensus,
+    normalize_weights,
+    save_consensus,
+)
+
+REFS = {
+    "v1": [
+        "a man is cooking food",
+        "a man cooks food in a kitchen",
+        "a man is cooking",
+        "purple elephants juggle quantum physics",   # outlier caption
+    ],
+    "v2": ["a dog runs", "the dog is running"],
+}
+
+
+def test_outlier_gets_lowest_consensus():
+    scores = compute_consensus_scores(REFS)
+    v1 = scores["v1"]
+    assert v1.shape == (4,)
+    assert np.argmin(v1) == 3          # the outlier
+    assert v1[3] < v1[:3].min()
+
+
+def test_consensus_captions_score_positive():
+    scores = compute_consensus_scores(REFS)
+    assert (scores["v1"][:3] > 0).all()
+
+
+def test_normalize_weights_mean_one():
+    scores = compute_consensus_scores(REFS)
+    weights = normalize_weights(scores, temperature=1.0)
+    for vid, w in weights.items():
+        assert w.mean() == pytest.approx(1.0)
+        assert (w >= 0).all()
+    # Outlier weight below average, consensus captions above the outlier.
+    assert weights["v1"][3] < 1.0
+    assert weights["v1"][3] == weights["v1"].min()
+
+
+def test_pickle_roundtrip(tmp_path):
+    scores = compute_consensus_scores(REFS)
+    p = str(tmp_path / "consensus.pkl")
+    save_consensus(p, scores)
+    loaded = load_consensus(p)
+    for k in scores:
+        np.testing.assert_allclose(loaded[k], scores[k])
+
+
+def test_single_caption_video():
+    scores = compute_consensus_scores({"v": ["only one caption"]})
+    assert scores["v"].shape == (1,) and scores["v"][0] == 0.0

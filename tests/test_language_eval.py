@@ -1,0 +1,55 @@
+import json
+
+import pytest
+
+from cst_captioning_tpu.metrics.coco_eval import language_eval, load_cocofmt_refs
+
+
+REFS = {
+    "vid1": ["A man is cooking food.", "a man cooks in a kitchen"],
+    "vid2": ["A dog runs in the park.", "the dog is running outside"],
+}
+
+
+def test_scores_all_metrics():
+    preds = [
+        {"image_id": "vid1", "caption": "a man is cooking food"},
+        {"image_id": "vid2", "caption": "a dog runs in the park"},
+    ]
+    out = language_eval(preds, REFS)
+    for key in ("Bleu_1", "Bleu_4", "METEOR", "ROUGE_L", "CIDEr"):
+        assert key in out
+    # Predictions match one reference each (mod tokenization) → near-perfect B1/ROUGE.
+    assert out["Bleu_1"] > 0.95
+    assert out["ROUGE_L"] > 0.95
+    assert out["CIDEr"] > 0.5
+
+
+def test_tokenization_normalizes_case_and_punct():
+    exact = [{"image_id": "vid1", "caption": "A man is cooking food."}]
+    noisy = [{"image_id": "vid1", "caption": "a man is cooking food"}]
+    assert language_eval(exact, REFS) == language_eval(noisy, REFS)
+
+
+def test_cocofmt_file_roundtrip(tmp_path):
+    coco = {
+        "annotations": [
+            {"image_id": "vid1", "caption": c} for c in REFS["vid1"]
+        ] + [
+            {"image_id": "vid2", "caption": c} for c in REFS["vid2"]
+        ],
+        "images": [{"id": "vid1"}, {"id": "vid2"}],
+    }
+    p = tmp_path / "refs_cocofmt.json"
+    p.write_text(json.dumps(coco))
+    refs = load_cocofmt_refs(str(p))
+    assert set(refs) == {"vid1", "vid2"}
+    preds = [{"image_id": "vid1", "caption": "a man is cooking food"},
+             {"image_id": "vid2", "caption": "a dog runs"}]
+    out = language_eval(preds, str(p))
+    assert out["Bleu_1"] > 0.5
+
+
+def test_missing_reference_raises():
+    with pytest.raises(KeyError):
+        language_eval([{"image_id": "nope", "caption": "x"}], REFS)

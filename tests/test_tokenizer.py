@@ -1,0 +1,59 @@
+from cst_captioning_tpu.metrics.tokenizer import tokenize, tokenize_corpus, tokenize_to_str
+
+
+def test_basic_lowercase_and_split():
+    assert tokenize("A man is Cooking.") == ["a", "man", "is", "cooking"]
+
+
+def test_punctuation_dropped():
+    assert tokenize("a dog, a cat; and a bird!") == ["a", "dog", "a", "cat", "and", "a", "bird"]
+    assert tokenize("wait... what?") == ["wait", "what"]
+
+
+def test_contractions_split():
+    # PTB splits the suffix off; coco-caption's punctuation filter keeps
+    # "'s"/"n't" tokens (only bare "'" is in its removal list).
+    assert tokenize("he doesn't stop") == ["he", "does", "n't", "stop"]
+    assert tokenize("it's the dog's ball") == ["it", "'s", "the", "dog", "'s", "ball"]
+    assert tokenize("they're running") == ["they", "'re", "running"]
+
+
+def test_special_splits():
+    assert tokenize("you cannot win") == ["you", "can", "not", "win"]
+    assert tokenize("I'm gonna go") == ["i", "'m", "gon", "na", "go"]
+
+
+def test_brackets_removed():
+    assert tokenize("a man (on a bike) rides") == ["a", "man", "on", "a", "bike", "rides"]
+
+
+def test_abbreviation_periods_kept():
+    # PTB keeps abbreviation-shaped tokens whole, including their periods.
+    assert "u.s." in tokenize("made in the u.s.")
+
+
+def test_mid_caption_sentence_periods_split():
+    assert tokenize("A man is cooking. He smiles.") == [
+        "a", "man", "is", "cooking", "he", "smiles",
+    ]
+
+
+def test_double_quotes_dropped():
+    assert tokenize('the "dog" runs') == ["the", "dog", "runs"]
+
+
+def test_bare_apostrophes_stripped():
+    assert tokenize("the dogs' bones") == ["the", "dogs", "bones"]
+    assert tokenize("'hello' there") == ["hello", "there"]
+    # ...but contraction tokens keep their apostrophe.
+    assert tokenize("the dog's bone") == ["the", "dog", "'s", "bone"]
+
+
+def test_corpus_shape():
+    out = tokenize_corpus({"v1": ["A man runs.", "The man is running"]})
+    assert out == {"v1": ["a man runs", "the man is running"]}
+
+
+def test_empty():
+    assert tokenize("") == []
+    assert tokenize("...") == []
