@@ -1,0 +1,18 @@
+"""Data layer: HDF5 feature/label datasets, batch streaming, prepro, fixtures."""
+
+from .dataset import CaptionDataset, SplitPaths
+from .loader import Batch, CaptionLoader, prefetch_to_device
+from .vocab import PAD_EOS, Vocab, build_vocab, load_vocab, save_vocab
+
+__all__ = [
+    "Batch",
+    "CaptionDataset",
+    "CaptionLoader",
+    "PAD_EOS",
+    "SplitPaths",
+    "Vocab",
+    "build_vocab",
+    "load_vocab",
+    "prefetch_to_device",
+    "save_vocab",
+]

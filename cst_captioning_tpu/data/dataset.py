@@ -1,0 +1,142 @@
+"""HDF5-backed caption dataset — the reference's on-disk contract, TPU-side.
+
+File schema (mirrors the reference's artifacts so a user's existing
+preprocessed MSR-VTT/MSVD data plugs in — SURVEY.md §2 "Data loader",
+§3.5 get_batch):
+
+- ``<split>_<modality>_feat.h5``: one file per modality, dataset ``"feats"``
+  of shape (N, D) (pooled, e.g. category one-hots) or (N, T, D) (temporal,
+  e.g. ResNet frame features, C3D clip features).  Row i belongs to the
+  i-th video of the split's video list in the info json.
+- ``<split>_label.h5``: datasets ``"labels"`` (M, L) int32 0-padded token
+  ids, ``"label_start_ix"`` and ``"label_end_ix"`` (N,) int64 giving video
+  i's caption rows as the half-open range [start, end)  (0-indexed, unlike
+  the reference's 1-indexed lua heritage — conversion happens in prepro).
+- ``info.json``: {"ix_to_word": {...}, "videos": [{"id": ..}, ..]} per split.
+- ``<split>_cocofmt.json``: coco-format references for metric eval.
+
+Feature rows are read lazily via h5py random access; the loader layer
+decides batching/prefetch.  All arrays come back as numpy — JAX device_put
+happens at the loader/trainer boundary, never here.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import h5py
+import numpy as np
+
+from .vocab import Vocab
+
+
+@dataclass
+class SplitPaths:
+    """Paths describing one split's artifacts (any feat count >= 1)."""
+
+    feat_h5: Sequence[str]
+    label_h5: str
+    info_json: str
+    cocofmt_json: Optional[str] = None
+
+
+class CaptionDataset:
+    """Random-access view over one split's HDF5 feature + label files."""
+
+    def __init__(self, paths: SplitPaths):
+        self.paths = paths
+        with open(paths.info_json) as f:
+            info = json.load(f)
+        self.vocab = Vocab.from_json(info["ix_to_word"])
+        self.video_ids: List[str] = [str(v["id"]) for v in info["videos"]]
+
+        self._feat_files = [h5py.File(p, "r") for p in paths.feat_h5]
+        self._feats = [f["feats"] for f in self._feat_files]
+        self._label_file = h5py.File(paths.label_h5, "r")
+        self.labels = self._label_file["labels"]          # (M, L)
+        self.label_start = np.asarray(self._label_file["label_start_ix"])
+        self.label_end = np.asarray(self._label_file["label_end_ix"])
+
+        n = len(self.video_ids)
+        for feats, path in zip(self._feats, paths.feat_h5):
+            if feats.shape[0] != n:
+                raise ValueError(
+                    f"{path}: {feats.shape[0]} feature rows != {n} videos in info json"
+                )
+        if len(self.label_start) != n or len(self.label_end) != n:
+            raise ValueError("label index arrays do not match video count")
+
+    # -- shapes ------------------------------------------------------------
+
+    @property
+    def num_videos(self) -> int:
+        return len(self.video_ids)
+
+    @property
+    def seq_length(self) -> int:
+        return self.labels.shape[1]
+
+    @property
+    def feat_dims(self) -> List[int]:
+        return [int(f.shape[-1]) for f in self._feats]
+
+    @property
+    def feat_times(self) -> List[int]:
+        """Temporal length per modality; 1 for pooled (N, D) features."""
+        return [int(f.shape[1]) if f.ndim == 3 else 1 for f in self._feats]
+
+    # -- access ------------------------------------------------------------
+
+    def features(self, video_ix: np.ndarray) -> List[np.ndarray]:
+        """Per-modality feature batches for the given video indices.
+
+        Pooled (N, D) modalities come back as (B, 1, D) so every modality is
+        uniformly (B, T_m, D_m) — static T_m per modality keeps XLA happy.
+        """
+        video_ix = np.asarray(video_ix)
+        # h5py fancy selection needs sorted unique indices; np.unique gives
+        # exactly that plus the gather map back to the requested order.
+        uniq, inv = np.unique(video_ix, return_inverse=True)
+        out = []
+        for feats in self._feats:
+            block = feats[uniq][inv]
+            if block.ndim == 2:
+                block = block[:, None, :]
+            out.append(block.astype(np.float32))
+        return out
+
+    def captions_for(self, video_ix: int) -> np.ndarray:
+        """(num_caps, L) label rows of one video."""
+        s, e = int(self.label_start[video_ix]), int(self.label_end[video_ix])
+        return np.asarray(self.labels[s:e], dtype=np.int32)
+
+    def num_captions(self, video_ix: int) -> int:
+        return int(self.label_end[video_ix] - self.label_start[video_ix])
+
+    def references(self) -> Dict[str, List[str]]:
+        """Ground-truth caption strings per video id (reward/eval path)."""
+        if self.paths.cocofmt_json:
+            with open(self.paths.cocofmt_json) as f:
+                coco = json.load(f)
+            refs: Dict[str, List[str]] = {}
+            for ann in coco["annotations"]:
+                refs.setdefault(str(ann["image_id"]), []).append(ann["caption"])
+            return refs
+        # fall back to decoding label ids
+        return {
+            vid: [self.vocab.decode(row) for row in self.captions_for(i)]
+            for i, vid in enumerate(self.video_ids)
+        }
+
+    def close(self) -> None:
+        for f in self._feat_files:
+            f.close()
+        self._label_file.close()
+
+    def __enter__(self) -> "CaptionDataset":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

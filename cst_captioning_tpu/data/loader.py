@@ -1,0 +1,219 @@
+"""Batch assembly + host->device streaming — the reference DataLoader, TPU-shaped.
+
+Responsibilities (SURVEY.md §3.5, restated for XLA):
+
+- fixed-shape batches: ``batch_size`` videos × ``seq_per_img`` captions,
+  labels always (B*seq_per_img, L) — static shapes so every jit traces once;
+- shuffled epoch order with wrap-around (partial final batches are filled
+  from the next epoch, matching the reference's infinite get_batch stream);
+- per-caption consensus weights for WXE (from the consensus pickle);
+- raw ground-truth strings carried alongside for the RL reward path;
+- multi-host sharding: each JAX process sees a disjoint stride of the
+  video list (``process_index``/``process_count``), the TPU-native
+  replacement for the reference's single-node DataParallel split;
+- ``prefetch_to_device``: a one-deep background thread pipelining h5 reads
+  + ``jax.device_put`` of batch t+1 under the step computation of batch t.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import CaptionDataset
+
+
+@dataclass
+class Batch:
+    """One training/eval batch. Feature arrays are (B, T_m, D_m); labels and
+    weights are flattened over (video, caption) -> (B*seq_per_img, ...)."""
+
+    feats: List[np.ndarray]
+    labels: np.ndarray                 # (B*S, L) int32, 0-padded
+    weights: np.ndarray                # (B*S,) float32 consensus weights (1.0 = XE)
+    video_ids: List[str]               # length B
+    gts: Dict[str, List[str]] = field(default_factory=dict)  # refs for reward
+    video_ix: Optional[np.ndarray] = None  # (B,) dataset indices
+
+    @property
+    def batch_videos(self) -> int:
+        return len(self.video_ids)
+
+
+class CaptionLoader:
+    """Infinite shuffled batch stream over a CaptionDataset split."""
+
+    def __init__(
+        self,
+        dataset: CaptionDataset,
+        batch_size: int,
+        seq_per_img: int = 20,
+        shuffle: bool = True,
+        seed: int = 0,
+        consensus_weights: Optional[Dict[str, np.ndarray]] = None,
+        process_index: int = 0,
+        process_count: int = 1,
+        include_gts: bool = False,
+    ):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.seq_per_img = seq_per_img
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed + process_index)
+        self.consensus_weights = consensus_weights
+        self.include_gts = include_gts
+        self._refs = dataset.references() if include_gts else None
+
+        # Multi-host shard: strided so every process gets an equal slice
+        # regardless of dataset ordering.
+        self._my_videos = np.arange(dataset.num_videos)[process_index::process_count]
+        if len(self._my_videos) == 0:
+            raise ValueError("process shard is empty; dataset smaller than host count")
+        self._order = self._my_videos.copy()
+        self._pos = len(self._order)  # force shuffle on first batch
+        self.epoch = 0
+
+    # -- epoch bookkeeping -------------------------------------------------
+
+    def _next_indices(self, n: int) -> np.ndarray:
+        out = []
+        while n > 0:
+            if self._pos >= len(self._order):
+                if self.shuffle:
+                    self._rng.shuffle(self._order)
+                self._pos = 0
+                self.epoch += 1
+            take = min(n, len(self._order) - self._pos)
+            out.append(self._order[self._pos : self._pos + take])
+            self._pos += take
+            n -= take
+        return np.concatenate(out)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return max(1, len(self._my_videos) // self.batch_size)
+
+    # -- batch assembly ----------------------------------------------------
+
+    def _pick_captions(self, video_ix: int) -> np.ndarray:
+        """(seq_per_img, L) caption rows; sample with replacement if short."""
+        caps = self.ds.captions_for(video_ix)
+        n = caps.shape[0]
+        if n >= self.seq_per_img:
+            sel = self._rng.choice(n, self.seq_per_img, replace=False) if self.shuffle \
+                else np.arange(self.seq_per_img)
+        else:
+            sel = self._rng.choice(n, self.seq_per_img, replace=True)
+        return caps[np.sort(sel)], np.sort(sel)
+
+    def next_batch(self) -> Batch:
+        ix = self._next_indices(self.batch_size)
+        feats = self.ds.features(ix)
+        labels = np.zeros((self.batch_size * self.seq_per_img, self.ds.seq_length),
+                          dtype=np.int32)
+        weights = np.ones(self.batch_size * self.seq_per_img, dtype=np.float32)
+        vids = []
+        for b, v in enumerate(ix):
+            rows, sel = self._pick_captions(int(v))
+            labels[b * self.seq_per_img : (b + 1) * self.seq_per_img] = rows
+            vid = self.ds.video_ids[int(v)]
+            vids.append(vid)
+            if self.consensus_weights is not None and vid in self.consensus_weights:
+                w = np.asarray(self.consensus_weights[vid], dtype=np.float32)
+                weights[b * self.seq_per_img : (b + 1) * self.seq_per_img] = w[sel]
+        gts = {}
+        if self.include_gts and self._refs is not None:
+            gts = {vid: self._refs[vid] for vid in vids if vid in self._refs}
+        return Batch(feats=feats, labels=labels, weights=weights,
+                     video_ids=vids, gts=gts, video_ix=ix)
+
+    def __iter__(self) -> Iterator[Batch]:
+        while True:
+            yield self.next_batch()
+
+    # -- eval iteration (single pass, in order) ----------------------------
+
+    def iter_eval(self) -> Iterator[Batch]:
+        """One ordered, non-shuffled pass; final batch wraps (callers dedupe
+        by video id).  Keeps shapes static for the jitted decode."""
+        n = len(self._my_videos)
+        for start in range(0, n, self.batch_size):
+            ix = self._my_videos[start : start + self.batch_size]
+            if len(ix) < self.batch_size:  # pad by cycling to keep shape static
+                pad = np.resize(self._my_videos, self.batch_size - len(ix))
+                ix = np.concatenate([ix, pad])
+            feats = self.ds.features(ix)
+            vids = [self.ds.video_ids[int(v)] for v in ix]
+            yield Batch(
+                feats=feats,
+                labels=np.zeros((self.batch_size * self.seq_per_img,
+                                 self.ds.seq_length), dtype=np.int32),
+                weights=np.ones(self.batch_size * self.seq_per_img, dtype=np.float32),
+                video_ids=vids,
+                video_ix=ix,
+            )
+
+
+def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
+                       device_put=None) -> Iterator[Batch]:
+    """Run batch assembly (h5 reads, numpy packing) in a background thread,
+    optionally applying ``device_put`` (e.g. a sharding-aware jax.device_put)
+    to feats/labels/weights before handing the batch to the consumer.
+
+    This is the TPU replacement for the reference's synchronous get_batch ->
+    .cuda() at the call site: HBM transfer of batch t+1 overlaps step t.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = object()
+    closed = threading.Event()  # consumer gone: worker must drop its buffers
+
+    def _put(item) -> bool:
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def work():
+        try:
+            for b in batches:
+                if device_put is not None:
+                    b = Batch(
+                        feats=[device_put(f) for f in b.feats],
+                        labels=device_put(b.labels),
+                        weights=device_put(b.weights),
+                        video_ids=b.video_ids,
+                        gts=b.gts,
+                        video_ix=b.video_ix,
+                    )
+                if not _put(b):
+                    return
+        except Exception as e:  # propagate into the consumer thread
+            _put(e)
+        _put(stop)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        # Consumers of the infinite stream exit via break/GeneratorExit; wake
+        # the worker so it stops holding prefetched (possibly HBM) buffers.
+        closed.set()
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -1,0 +1,142 @@
+"""Offline preprocessing — vocab/label/cocofmt/df/consensus artifact builders.
+
+The reference ships these as ad-hoc scripts + downloadable pickles
+(SURVEY.md §2 "Offline prepro": build vocab + label h5 from annotations,
+convert refs to coco format, precompute the CIDEr df pickle and the
+per-caption consensus scores pickle).  Here they are one importable module
+with a CLI:
+
+    python -m cst_captioning_tpu.data.prepro \
+        --annotations anns.json --split train --out_dir data/ \
+        [--count_threshold 3] [--max_len 30] [--vocab_json existing.json]
+
+``annotations`` format: {"videos": [{"id": ..., "captions": [...]}, ...]} —
+the minimal dataset-agnostic shape MSVD/MSR-VTT/ActivityNet exports all map
+onto.  Feature h5s are produced by upstream CNN extraction and are consumed
+as-is (the reference never ran CNNs either).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import h5py
+import numpy as np
+
+from ..metrics import (
+    build_corpus_df,
+    compute_consensus_scores,
+    normalize_weights,
+    save_consensus,
+    save_corpus_df,
+    tokenize,
+)
+from .vocab import Vocab, build_vocab, load_vocab, save_vocab
+
+
+def load_annotations(path: str) -> List[dict]:
+    with open(path) as f:
+        obj = json.load(f)
+    return obj["videos"] if isinstance(obj, dict) else obj
+
+
+def build_split(
+    annotations: Sequence[dict],
+    out_dir: str,
+    split: str,
+    max_len: int = 30,
+    count_threshold: int = 1,
+    vocab: Optional[Vocab] = None,
+    build_reward_artifacts: bool = True,
+) -> Dict[str, str]:
+    """Build every offline artifact for one split; returns the path map."""
+    os.makedirs(out_dir, exist_ok=True)
+    video_ids = [str(v["id"]) for v in annotations]
+    raw_caps = [[str(c) for c in v["captions"]] for v in annotations]
+    tokenized = [[tokenize(c) for c in caps] for caps in raw_caps]
+
+    if vocab is None:
+        vocab = build_vocab(
+            (t for caps in tokenized for t in caps), count_threshold=count_threshold
+        )
+    paths: Dict[str, str] = {}
+
+    vocab_path = os.path.join(out_dir, f"{split}_vocab.json")
+    save_vocab(vocab_path, vocab)
+    paths["vocab_json"] = vocab_path
+
+    info_path = os.path.join(out_dir, f"{split}_info.json")
+    with open(info_path, "w") as f:
+        json.dump({"ix_to_word": vocab.to_json(),
+                   "videos": [{"id": v} for v in video_ids]}, f)
+    paths["info_json"] = info_path
+
+    rows, starts, ends = [], [], []
+    for caps in tokenized:
+        starts.append(len(rows))
+        rows.extend(vocab.encode(t, max_len) for t in caps)
+        ends.append(len(rows))
+    label_path = os.path.join(out_dir, f"{split}_label.h5")
+    with h5py.File(label_path, "w") as f:
+        f.create_dataset("labels", data=np.stack(rows).astype(np.int32))
+        f.create_dataset("label_start_ix", data=np.asarray(starts, dtype=np.int64))
+        f.create_dataset("label_end_ix", data=np.asarray(ends, dtype=np.int64))
+    paths["label_h5"] = label_path
+
+    coco_path = os.path.join(out_dir, f"{split}_cocofmt.json")
+    with open(coco_path, "w") as f:
+        json.dump({
+            "images": [{"id": v} for v in video_ids],
+            "annotations": [
+                {"image_id": vid, "id": f"{vid}#{j}", "caption": c}
+                for vid, caps in zip(video_ids, raw_caps)
+                for j, c in enumerate(caps)
+            ],
+        }, f)
+    paths["cocofmt_json"] = coco_path
+
+    if build_reward_artifacts:
+        tok_refs = {vid: [" ".join(t) for t in toks]
+                    for vid, toks in zip(video_ids, tokenized)}
+        df, ndocs = build_corpus_df(tok_refs)
+        df_path = os.path.join(out_dir, f"{split}_ciderdf.pkl")
+        save_corpus_df(df_path, df, ndocs)
+        paths["cached_tokens"] = df_path
+
+        cons_path = os.path.join(out_dir, f"{split}_consensus.pkl")
+        save_consensus(cons_path, normalize_weights(compute_consensus_scores(tok_refs)))
+        paths["consensus_pkl"] = cons_path
+    return paths
+
+
+def main(argv: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--annotations", required=True)
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--max_len", type=int, default=30)
+    ap.add_argument("--count_threshold", type=int, default=1)
+    ap.add_argument("--vocab_json", default=None,
+                    help="reuse an existing vocab (val/test splits)")
+    ap.add_argument("--no_reward_artifacts", action="store_true")
+    args = ap.parse_args(argv)
+
+    vocab = load_vocab(args.vocab_json) if args.vocab_json else None
+    paths = build_split(
+        load_annotations(args.annotations),
+        args.out_dir,
+        args.split,
+        max_len=args.max_len,
+        count_threshold=args.count_threshold,
+        vocab=vocab,
+        build_reward_artifacts=not args.no_reward_artifacts,
+    )
+    print(json.dumps(paths, indent=2))
+    return paths
+
+
+if __name__ == "__main__":
+    main()

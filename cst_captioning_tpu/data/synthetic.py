@@ -1,0 +1,104 @@
+"""Synthetic tiny-dataset generator — the test/bench fixture factory.
+
+The reference had no fixtures at all (SURVEY.md §4); this generator stands in
+for its MSVD/MSR-VTT downloads: it emits the exact on-disk artifact set the
+real pipeline uses, with captions drawn from a tiny grammar whose content
+correlates with the feature vectors — so models can genuinely overfit it
+(XE loss -> ~0) and reward-driven training has signal.
+
+All label/info/cocofmt/reward artifacts are produced by the real
+``prepro.build_split`` (fixtures can never diverge from the production
+schema); only the feature h5s are synthesized here.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import h5py
+import numpy as np
+
+from ..metrics import tokenize
+from .prepro import build_split
+from .vocab import Vocab, load_vocab
+
+_SUBJECTS = ["a man", "a woman", "a dog", "a cat", "a child"]
+_VERBS = ["is cooking", "is running", "is singing", "is playing", "is dancing"]
+_OBJECTS = ["in the kitchen", "in the park", "on stage", "with a ball", "outside"]
+
+
+@dataclass
+class SyntheticSpec:
+    num_videos: int = 8
+    captions_per_video: int = 5
+    max_len: int = 16
+    feat_dims: Tuple[int, ...] = (32, 16)     # e.g. tiny "resnet" + "c3d"
+    feat_times: Tuple[int, ...] = (4, 1)      # temporal frames per modality
+    seed: int = 0
+
+
+def _make_captions(rng: np.random.Generator, spec: SyntheticSpec) -> List[List[str]]:
+    """Per video: one (subject, verb, object) concept + paraphrase captions."""
+    all_caps = []
+    for _ in range(spec.num_videos):
+        s = _SUBJECTS[rng.integers(len(_SUBJECTS))]
+        v = _VERBS[rng.integers(len(_VERBS))]
+        o = _OBJECTS[rng.integers(len(_OBJECTS))]
+        caps = []
+        for j in range(spec.captions_per_video):
+            drop_o = j % 3 == 2
+            caps.append(f"{s} {v}" if drop_o else f"{s} {v} {o}")
+        all_caps.append(caps)
+    return all_caps
+
+
+def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpec(),
+             vocab: Vocab | None = None) -> Dict[str, str]:
+    """Write one split's artifact set under ``root``; returns the path map.
+
+    Pass the train split's vocab when generating val/test so ids agree.
+    """
+    # crc32, not hash(): str hashing is salted per process and would make
+    # regenerated splits differ between interpreter runs.
+    rng = np.random.default_rng(spec.seed + zlib.crc32(split.encode()))
+    captions = _make_captions(rng, spec)
+    video_ids = [f"{split}_video{i}" for i in range(spec.num_videos)]
+
+    paths = build_split(
+        [{"id": v, "captions": caps} for v, caps in zip(video_ids, captions)],
+        root, split, max_len=spec.max_len, vocab=vocab,
+    )
+    vocab = load_vocab(paths["vocab_json"])
+
+    # Features: deterministic per-video signal derived from the first
+    # caption's token ids, so features genuinely predict captions.
+    feat_paths = []
+    for m, (dim, t_len) in enumerate(zip(spec.feat_dims, spec.feat_times)):
+        feats = np.zeros((spec.num_videos, t_len, dim), dtype=np.float32)
+        for i, caps in enumerate(captions):
+            concept = rng.standard_normal(dim) * 0.1
+            ids = vocab.encode(tokenize(caps[0]), spec.max_len)
+            for tok in ids[ids > 0]:
+                concept[int(tok) % dim] += 1.0
+            feats[i] = concept[None, :] + 0.01 * rng.standard_normal((t_len, dim))
+        p = f"{root}/{split}_feat{m}.h5"
+        with h5py.File(p, "w") as f:
+            f.create_dataset("feats", data=feats if t_len > 1 else feats[:, 0, :])
+        feat_paths.append(p)
+    paths["feat_h5"] = json.dumps(feat_paths)
+    return paths
+
+
+def split_paths(paths: Dict[str, str]):
+    """Convert a generate() path map into a dataset.SplitPaths."""
+    from .dataset import SplitPaths
+
+    return SplitPaths(
+        feat_h5=json.loads(paths["feat_h5"]),
+        label_h5=paths["label_h5"],
+        info_json=paths["info_json"],
+        cocofmt_json=paths["cocofmt_json"],
+    )

@@ -1,0 +1,106 @@
+"""Vocabulary and sequence<->string conversion.
+
+Token-id convention (matches the reference's neuraltalk-style labels —
+SURVEY.md §3.5: labels are 0-padded int matrices, decoding stops at 0):
+
+- id 0 is PAD and EOS at once: sequences end at the first 0, padding is 0.
+- real words occupy ids 1..V.
+- the decoder's BOS *input* is also id 0 (0 never occurs as a real word, so
+  feeding it at t=0 is unambiguous); the embedding table has V+1 rows.
+
+This one-symbol-fits-all scheme keeps masks trivial (`mask = cummax(seq==0)`
+logic) and is exactly what the reference's CrossEntropyCriterion/``decode_sequence``
+assume, so checkpoint semantics and caption truncation behave identically.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Mapping, Sequence
+
+import numpy as np
+
+PAD_EOS = 0  # id 0: padding, end-of-sequence, and the decoder's BOS input
+UNK_TOKEN = "<unk>"
+
+
+class Vocab:
+    """Immutable word<->id mapping with id 0 reserved for PAD/EOS/BOS."""
+
+    def __init__(self, ix_to_word: Mapping[int, str]):
+        self.ix_to_word: Dict[int, str] = {int(k): v for k, v in ix_to_word.items()}
+        if PAD_EOS in self.ix_to_word:
+            raise ValueError("id 0 is reserved for PAD/EOS")
+        self.word_to_ix: Dict[str, int] = {w: i for i, w in self.ix_to_word.items()}
+        self.unk_ix = self.word_to_ix.get(UNK_TOKEN)
+
+    def __len__(self) -> int:
+        # number of real words; embedding tables need len(vocab)+1 rows
+        return len(self.ix_to_word)
+
+    @property
+    def size_with_pad(self) -> int:
+        return len(self.ix_to_word) + 1
+
+    def encode(self, tokens: Sequence[str], max_len: int) -> np.ndarray:
+        """Tokens -> fixed-length id row, 0-padded (EOS implicit at first 0)."""
+        out = np.zeros(max_len, dtype=np.int32)
+        j = 0
+        for w in tokens:
+            if j >= max_len:
+                break
+            ix = self.word_to_ix.get(w, self.unk_ix)
+            if ix is None:  # no <unk> in vocab: drop unknown words (no 0-hole,
+                continue    # which would read as premature EOS)
+            out[j] = ix
+            j += 1
+        return out
+
+    def decode(self, ids: Iterable[int]) -> str:
+        """Id sequence -> caption string, stopping at the first 0 (EOS)."""
+        words = []
+        for i in ids:
+            i = int(i)
+            if i == PAD_EOS:
+                break
+            words.append(self.ix_to_word.get(i, UNK_TOKEN))
+        return " ".join(words)
+
+    def decode_batch(self, seqs: np.ndarray) -> List[str]:
+        """(B, L) id matrix -> list of caption strings (the reward-path
+        device->host conversion; SURVEY.md §3.2)."""
+        return [self.decode(row) for row in np.asarray(seqs)]
+
+    def to_json(self) -> Dict[str, str]:
+        return {str(k): v for k, v in self.ix_to_word.items()}
+
+    @classmethod
+    def from_json(cls, obj: Mapping[str, str]) -> "Vocab":
+        return cls({int(k): v for k, v in obj.items()})
+
+
+def build_vocab(
+    tokenized_captions: Iterable[Sequence[str]],
+    count_threshold: int = 1,
+    add_unk: bool = True,
+) -> Vocab:
+    """Frequency-thresholded vocabulary (the reference's prepro policy:
+    words below the count threshold collapse to <unk>)."""
+    counts = Counter()
+    for toks in tokenized_captions:
+        counts.update(toks)
+    words = sorted(w for w, c in counts.items() if c >= count_threshold)
+    if add_unk and UNK_TOKEN not in words:
+        words.append(UNK_TOKEN)
+    return Vocab({i + 1: w for i, w in enumerate(words)})
+
+
+def save_vocab(path: str, vocab: Vocab) -> None:
+    with open(path, "w") as f:
+        json.dump({"ix_to_word": vocab.to_json()}, f)
+
+
+def load_vocab(path: str) -> Vocab:
+    with open(path) as f:
+        return Vocab.from_json(json.load(f)["ix_to_word"])

@@ -1,0 +1,225 @@
+import json
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data import (
+    Batch,
+    CaptionDataset,
+    CaptionLoader,
+    PAD_EOS,
+    Vocab,
+    build_vocab,
+    prefetch_to_device,
+)
+from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate, split_paths
+from cst_captioning_tpu.metrics.consensus import load_consensus
+
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("synth"))
+    paths = generate(root, "train", SyntheticSpec(num_videos=8, captions_per_video=5))
+    return paths
+
+
+@pytest.fixture(scope="module")
+def ds(synth):
+    return CaptionDataset(split_paths(synth))
+
+
+class TestVocab:
+    def test_roundtrip(self):
+        v = build_vocab([["a", "dog", "runs"], ["a", "cat"]])
+        ids = v.encode(["a", "dog", "runs"], max_len=6)
+        assert ids.shape == (6,)
+        assert v.decode(ids) == "a dog runs"
+
+    def test_zero_reserved(self):
+        v = build_vocab([["word"]])
+        assert 0 not in v.ix_to_word
+        with pytest.raises(ValueError):
+            Vocab({0: "bad"})
+
+    def test_unknown_maps_to_unk(self):
+        v = build_vocab([["a", "dog"]])
+        ids = v.encode(["a", "zebra"], max_len=4)
+        assert v.decode(ids) == "a <unk>"
+
+    def test_decode_stops_at_eos(self):
+        v = build_vocab([["a", "dog"]])
+        a, dog = v.word_to_ix["a"], v.word_to_ix["dog"]
+        assert v.decode([a, PAD_EOS, dog]) == "a"
+
+
+class TestDataset:
+    def test_shapes(self, ds):
+        assert ds.num_videos == 8
+        assert ds.feat_dims == [32, 16]
+        assert ds.feat_times == [4, 1]
+        assert ds.seq_length == 16
+
+    def test_features_batch(self, ds):
+        feats = ds.features(np.array([3, 1, 1, 6]))
+        assert feats[0].shape == (4, 4, 32)
+        assert feats[1].shape == (4, 1, 16)
+        # duplicate + order preserved
+        np.testing.assert_array_equal(feats[0][1], feats[0][2])
+        single = ds.features(np.array([3]))[0][0]
+        np.testing.assert_array_equal(feats[0][0], single)
+
+    def test_captions(self, ds):
+        caps = ds.captions_for(0)
+        assert caps.shape == (5, 16)
+        assert caps.dtype == np.int32
+        assert (caps[:, 0] != 0).all()  # every caption starts with a word
+
+    def test_references_from_cocofmt(self, ds):
+        refs = ds.references()
+        assert len(refs) == 8
+        assert all(len(v) == 5 for v in refs.values())
+
+    def test_mismatched_videos_raises(self, synth, tmp_path):
+        import h5py
+        from cst_captioning_tpu.data.dataset import SplitPaths
+
+        bad_info = tmp_path / "bad_info.json"
+        with open(synth["info_json"]) as f:
+            info = json.load(f)
+        info["videos"] = info["videos"][:-1]
+        bad_info.write_text(json.dumps(info))
+        sp = split_paths(synth)
+        with pytest.raises(ValueError):
+            CaptionDataset(SplitPaths(feat_h5=sp.feat_h5, label_h5=sp.label_h5,
+                                      info_json=str(bad_info)))
+
+
+class TestLoader:
+    def test_batch_shapes(self, ds):
+        loader = CaptionLoader(ds, batch_size=4, seq_per_img=3, seed=1)
+        b = loader.next_batch()
+        assert b.feats[0].shape == (4, 4, 32)
+        assert b.labels.shape == (12, 16)
+        assert b.weights.shape == (12,)
+        assert len(b.video_ids) == 4
+
+    def test_epoch_wrap_covers_all_videos(self, ds):
+        loader = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=0)
+        seen = set()
+        for _ in range(6):  # 18 draws over 8 videos
+            seen.update(loader.next_batch().video_ids)
+        assert len(seen) == 8
+        assert loader.epoch >= 2
+
+    def test_deterministic_given_seed(self, ds):
+        a = CaptionLoader(ds, batch_size=4, seq_per_img=2, seed=7).next_batch()
+        b = CaptionLoader(ds, batch_size=4, seq_per_img=2, seed=7).next_batch()
+        assert a.video_ids == b.video_ids
+        np.testing.assert_array_equal(a.labels, b.labels)
+
+    def test_consensus_weights_applied(self, ds, synth):
+        weights = load_consensus(synth["consensus_pkl"])
+        loader = CaptionLoader(ds, batch_size=4, seq_per_img=5, shuffle=False,
+                               consensus_weights=weights)
+        b = loader.next_batch()
+        assert not np.allclose(b.weights, 1.0)  # real consensus variation
+        # per-video mean weight ~1 (normalize_weights contract)
+        for i in range(4):
+            assert b.weights[i * 5 : (i + 1) * 5].mean() == pytest.approx(1.0, abs=1e-5)
+
+    def test_gts_for_reward(self, ds):
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, include_gts=True)
+        b = loader.next_batch()
+        assert set(b.gts.keys()) == set(b.video_ids)
+
+    def test_host_sharding_disjoint(self, ds):
+        l0 = CaptionLoader(ds, batch_size=2, process_index=0, process_count=2)
+        l1 = CaptionLoader(ds, batch_size=2, process_index=1, process_count=2)
+        assert set(l0._my_videos.tolist()).isdisjoint(l1._my_videos.tolist())
+        assert len(l0._my_videos) + len(l1._my_videos) == 8
+
+    def test_eval_iteration_covers_split_once(self, ds):
+        loader = CaptionLoader(ds, batch_size=3, shuffle=False)
+        ids = []
+        for b in loader.iter_eval():
+            ids.extend(b.video_ids)
+        assert len(ids) == 9  # 3 batches of 3 (last wraps)
+        assert set(ids) == set(ds.video_ids)
+
+    def test_prefetch_matches_direct(self, ds):
+        direct = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=3)
+        pref = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=3)
+        it = prefetch_to_device(iter(pref), size=2)
+        for _ in range(3):
+            a, b = direct.next_batch(), next(it)
+            assert a.video_ids == b.video_ids
+            np.testing.assert_array_equal(a.labels, b.labels)
+
+    def test_prefetch_device_put_applied(self, ds):
+        import jax.numpy as jnp
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2)
+        it = prefetch_to_device(iter(loader), device_put=jnp.asarray)
+        b = next(it)
+        assert isinstance(b.labels, jnp.ndarray)
+
+
+class TestPrepro:
+    def test_cli_roundtrip(self, tmp_path):
+        anns = {"videos": [
+            {"id": "v0", "captions": ["A man is cooking.", "a man cooks"]},
+            {"id": "v1", "captions": ["A dog runs.", "the dog is running"]},
+        ]}
+        ann_path = tmp_path / "anns.json"
+        ann_path.write_text(json.dumps(anns))
+        from cst_captioning_tpu.data.prepro import main
+
+        paths = main(["--annotations", str(ann_path), "--split", "train",
+                      "--out_dir", str(tmp_path / "out"), "--max_len", "8"])
+        from cst_captioning_tpu.data.dataset import SplitPaths
+
+        ds = CaptionDataset(SplitPaths(
+            feat_h5=[], label_h5=paths["label_h5"], info_json=paths["info_json"],
+            cocofmt_json=paths["cocofmt_json"]))
+        assert ds.num_videos == 2
+        # vocab round-trips through the label encoding
+        assert ds.vocab.decode(ds.captions_for(0)[1]) == "a man cooks"
+        refs = ds.references()
+        assert refs["v0"] == ["A man is cooking.", "a man cooks"]
+
+
+class TestReviewRegressions:
+    def test_encode_no_eos_hole_without_unk(self):
+        v = build_vocab([["a", "dog"]], add_unk=False)
+        ids = v.encode(["a", "zebra", "dog"], max_len=4)
+        # unknown word dropped, no 0-hole: "dog" must survive
+        assert v.decode(ids) == "a dog"
+
+    def test_iter_eval_static_shape_tiny_shard(self, ds):
+        loader = CaptionLoader(ds, batch_size=20, shuffle=False)  # 20 > 2*8
+        batches = list(loader.iter_eval())
+        assert len(batches) == 1
+        assert batches[0].feats[0].shape[0] == 20
+        assert len(batches[0].video_ids) == 20
+
+    def test_synthetic_reproducible_across_calls(self, tmp_path):
+        from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+        import h5py
+        a = generate(str(tmp_path / "a"), "val", SyntheticSpec(num_videos=3))
+        b = generate(str(tmp_path / "b"), "val", SyntheticSpec(num_videos=3))
+        with h5py.File(json.loads(a["feat_h5"])[0]) as fa, \
+             h5py.File(json.loads(b["feat_h5"])[0]) as fb:
+            np.testing.assert_array_equal(fa["feats"][:], fb["feats"][:])
+
+    def test_prefetch_early_exit_releases_worker(self, ds):
+        import threading
+        before = threading.active_count()
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2)
+        it = prefetch_to_device(iter(loader), size=2)
+        next(it)
+        it.close()  # consumer walks away from the infinite stream
+        import time
+        for _ in range(50):
+            if threading.active_count() <= before:
+                break
+            time.sleep(0.05)
+        assert threading.active_count() <= before
