@@ -52,21 +52,35 @@ class CaptionDataset:
         self.vocab = Vocab.from_json(info["ix_to_word"])
         self.video_ids: List[str] = [str(v["id"]) for v in info["videos"]]
 
-        self._feat_files = [h5py.File(p, "r") for p in paths.feat_h5]
-        self._feats = [f["feats"] for f in self._feat_files]
-        self._label_file = h5py.File(paths.label_h5, "r")
-        self.labels = self._label_file["labels"]          # (M, L)
-        self.label_start = np.asarray(self._label_file["label_start_ix"])
-        self.label_end = np.asarray(self._label_file["label_end_ix"])
+        opened: list = []  # close these if validation below fails
+        try:
+            self._feat_files = [h5py.File(p, "r") for p in paths.feat_h5]
+            opened.extend(self._feat_files)
+            self._feats = [f["feats"] for f in self._feat_files]
+            self._label_file = h5py.File(paths.label_h5, "r")
+            opened.append(self._label_file)
+            self.labels = self._label_file["labels"]          # (M, L)
+            self.label_start = np.asarray(self._label_file["label_start_ix"])
+            self.label_end = np.asarray(self._label_file["label_end_ix"])
 
-        n = len(self.video_ids)
-        for feats, path in zip(self._feats, paths.feat_h5):
-            if feats.shape[0] != n:
+            n = len(self.video_ids)
+            for feats, path in zip(self._feats, paths.feat_h5):
+                if feats.shape[0] != n:
+                    raise ValueError(
+                        f"{path}: {feats.shape[0]} feature rows != {n} videos in info json"
+                    )
+            if len(self.label_start) != n or len(self.label_end) != n:
+                raise ValueError("label index arrays do not match video count")
+            empty = np.flatnonzero(self.label_end <= self.label_start)
+            if len(empty):
                 raise ValueError(
-                    f"{path}: {feats.shape[0]} feature rows != {n} videos in info json"
+                    f"videos with zero captions: "
+                    f"{[self.video_ids[i] for i in empty[:5]]}"
                 )
-        if len(self.label_start) != n or len(self.label_end) != n:
-            raise ValueError("label index arrays do not match video count")
+        except Exception:
+            for f in opened:
+                f.close()
+            raise
 
     # -- shapes ------------------------------------------------------------
 

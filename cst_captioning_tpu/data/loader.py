@@ -20,7 +20,7 @@ from __future__ import annotations
 import queue
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -99,16 +99,22 @@ class CaptionLoader:
 
     # -- batch assembly ----------------------------------------------------
 
-    def _pick_captions(self, video_ix: int) -> np.ndarray:
-        """(seq_per_img, L) caption rows; sample with replacement if short."""
+    def _pick_captions(self, video_ix: int) -> Tuple[np.ndarray, np.ndarray]:
+        """-> ((seq_per_img, L) caption rows, their indices within the video's
+        caption list); samples with replacement if the video has fewer."""
         caps = self.ds.captions_for(video_ix)
         n = caps.shape[0]
+        if n == 0:
+            raise ValueError(
+                f"video {self.ds.video_ids[video_ix]!r} has no captions"
+            )
         if n >= self.seq_per_img:
             sel = self._rng.choice(n, self.seq_per_img, replace=False) if self.shuffle \
                 else np.arange(self.seq_per_img)
         else:
             sel = self._rng.choice(n, self.seq_per_img, replace=True)
-        return caps[np.sort(sel)], np.sort(sel)
+        sel = np.sort(sel)
+        return caps[sel], sel
 
     def next_batch(self) -> Batch:
         ix = self._next_indices(self.batch_size)

@@ -56,6 +56,11 @@ def build_split(
     os.makedirs(out_dir, exist_ok=True)
     video_ids = [str(v["id"]) for v in annotations]
     raw_caps = [[str(c) for c in v["captions"]] for v in annotations]
+    empty = [vid for vid, caps in zip(video_ids, raw_caps) if not caps]
+    if empty:
+        raise ValueError(
+            f"videos with zero captions (fix or drop them): {empty[:5]}"
+        )
     tokenized = [[tokenize(c) for c in caps] for caps in raw_caps]
 
     if vocab is None:

@@ -1,0 +1,16 @@
+"""Model zoo: feature encoder, attention-LSTM / Transformer caption decoders."""
+
+from .captioner import CaptionModel, repeat_for_captions, shift_right
+from .decoder_lstm import DecoderCell, scan_decoder
+from .decoder_transformer import TransformerDecoder
+from .encoder import FeatureEncoder
+
+__all__ = [
+    "CaptionModel",
+    "DecoderCell",
+    "FeatureEncoder",
+    "TransformerDecoder",
+    "repeat_for_captions",
+    "scan_decoder",
+    "shift_right",
+]

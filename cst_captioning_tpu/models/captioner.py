@@ -1,0 +1,170 @@
+"""CaptionModel — encoder + decoder with the reference's three surfaces.
+
+The reference ``CaptionModel`` exposes teacher-forced ``forward``, stochastic
+``sample`` and ``sample_beam`` (SURVEY.md §2).  Here the model owns *state
+and parameters only*; the decoding algorithms live in ``ops/sampling.py`` /
+``ops/beam.py`` as pure functions over the model's ``decode`` step — so jit,
+shard_map and the samplers compose without method-boundary tracing issues.
+
+Surfaces:
+- ``__call__(feats, labels, seq_per_img)`` — teacher-forced logits for
+  XE/WXE/RL-gradient computation (one compiled ``nn.scan`` over time).
+- ``encode(feats)`` — memory/pooled summaries, once per video batch.
+- ``decode(carry, tokens, ...)`` — run the decoder over a token block;
+  length-1 blocks are the autoregressive step for samplers and beam.
+- ``init_carry(pooled)`` — decoder start state from the fused feature.
+
+The pooled/no-attention configuration (``use_attention=False``) reproduces
+the reference's mean-pool architecture; attention (default) is the
+north-star attention-LSTM.  ``decoder_type="transformer"`` swaps in the
+Transformer decoder (driver config 5) behind the same four surfaces.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .decoder_lstm import Carry, DecoderCell, scan_decoder
+from .decoder_transformer import TransformerDecoder
+from .encoder import FeatureEncoder
+
+
+def shift_right(labels: jnp.ndarray) -> jnp.ndarray:
+    """Teacher-forcing inputs: BOS (=0) then the target prefix."""
+    return jnp.concatenate(
+        [jnp.zeros_like(labels[:, :1]), labels[:, :-1]], axis=1
+    )
+
+
+def repeat_for_captions(x: jnp.ndarray, seq_per_img: int) -> jnp.ndarray:
+    """(B, ...) -> (B*S, ...): align per-video encodings with per-caption rows."""
+    if seq_per_img == 1:
+        return x
+    return jnp.repeat(x, seq_per_img, axis=0)
+
+
+class CaptionModel(nn.Module):
+    vocab_size: int                 # embedding rows: len(vocab) + 1 (id 0 = PAD/EOS/BOS)
+    embed_size: int = 512
+    hidden_size: int = 512
+    num_layers: int = 1
+    attn_size: int = 512
+    use_attention: bool = True
+    dropout_rate: float = 0.5
+    decoder_type: str = "lstm"      # "lstm" | "transformer"
+    num_heads: int = 8              # transformer only
+    num_tx_layers: int = 2          # transformer only
+    tx_max_len: int = 64            # transformer only: positional-table size;
+                                    # must cover the label seq_length
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
+                                      self.dtype, name="encoder")
+        if self.decoder_type == "lstm":
+            self.memory_proj = nn.Dense(self.attn_size, use_bias=False,
+                                        dtype=self.dtype, name="memory_proj")
+            self.cell = scan_decoder()(
+                vocab_size=self.vocab_size,
+                embed_size=self.embed_size,
+                hidden_size=self.hidden_size,
+                num_layers=self.num_layers,
+                attn_size=self.attn_size,
+                use_attention=self.use_attention,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name="cell",
+            )
+            self.state_init = [
+                nn.Dense(2 * self.hidden_size, dtype=self.dtype, name=f"state_init_{l}")
+                for l in range(self.num_layers)
+            ]
+        elif self.decoder_type == "transformer":
+            self.tx = TransformerDecoder(
+                vocab_size=self.vocab_size,
+                embed_size=self.embed_size,
+                hidden_size=self.hidden_size,
+                num_layers=self.num_tx_layers,
+                num_heads=self.num_heads,
+                dropout_rate=self.dropout_rate,
+                max_len=self.tx_max_len,
+                dtype=self.dtype,
+                name="tx",
+            )
+        else:
+            raise ValueError(f"unknown decoder_type {self.decoder_type!r}")
+
+    # -- encoding ----------------------------------------------------------
+
+    def encode(self, feats: Sequence[jnp.ndarray], train: bool = False):
+        """-> (memory (B,T,H), proj_mem (B,T,A), pooled (B,H))."""
+        memory, pooled = self.encoder(feats, train=train)
+        if self.decoder_type == "lstm":
+            proj_mem = self.memory_proj(memory)
+        else:
+            proj_mem = memory  # transformer cross-attn projects internally
+        return memory, proj_mem, pooled
+
+    # -- decoder state -----------------------------------------------------
+
+    def init_carry(self, pooled: jnp.ndarray, max_len: int = 0) -> Carry:
+        """Start state from the fused feature.
+
+        LSTM: per-layer (c, h) via a learned projection (the reference
+        initializes its LSTM from the embedded video feature).
+        Transformer: a (token-buffer, position) pair of static size
+        ``max_len`` (required > 0).
+        """
+        if self.decoder_type == "lstm":
+            carry = []
+            for layer in range(self.num_layers):
+                ch = jnp.tanh(self.state_init[layer](pooled))
+                c, h = jnp.split(ch, 2, axis=-1)
+                carry.append((c, h))
+            return tuple(carry)
+        if max_len <= 0:
+            raise ValueError("transformer carry needs max_len > 0")
+        n = pooled.shape[0]
+        buf = jnp.zeros((n, max_len), dtype=jnp.int32)
+        return (buf, jnp.zeros((), dtype=jnp.int32))
+
+    # -- decoding ----------------------------------------------------------
+
+    def decode(
+        self,
+        carry,
+        tokens: jnp.ndarray,        # (B, L) int32; L==1 for autoregressive step
+        memory: jnp.ndarray,
+        proj_mem: jnp.ndarray,
+        pooled: jnp.ndarray,
+        train: bool = False,
+    ):
+        """-> (carry, logits (B, L, V))."""
+        if self.decoder_type == "lstm":
+            return self.cell(carry, tokens, memory, proj_mem, pooled, train)
+        return self.tx.decode(carry, tokens, memory, pooled, train=train)
+
+    # -- teacher-forced training surface -----------------------------------
+
+    def __call__(
+        self,
+        feats: Sequence[jnp.ndarray],
+        labels: jnp.ndarray,         # (B*seq_per_img, L)
+        seq_per_img: int = 1,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        memory, proj_mem, pooled = self.encode(feats, train=train)
+        memory = repeat_for_captions(memory, seq_per_img)
+        proj_mem = repeat_for_captions(proj_mem, seq_per_img)
+        pooled = repeat_for_captions(pooled, seq_per_img)
+        inputs = shift_right(labels)
+        if self.decoder_type == "lstm":
+            carry = self.init_carry(pooled)
+            _, logits = self.decode(carry, inputs, memory, proj_mem, pooled,
+                                    train=train)
+        else:
+            logits = self.tx(inputs, memory, pooled, train=train)
+        return logits

@@ -1,0 +1,89 @@
+"""Attention-LSTM caption decoder — the flagship decode path.
+
+The reference decoder (SURVEY.md §2 "Captioning model") is a 1–2 layer LSTM
+over word embeddings with the fused video feature initializing the state.
+TPU-first rebuild:
+
+- the per-step computation lives in one ``DecoderCell`` module; teacher
+  forcing, sampling and beam search all drive the SAME cell (same param
+  tree), either under ``nn.scan`` (training: whole sequence in one compiled
+  scan, weights broadcast — no Python-per-timestep) or as a length-1 scan
+  (autoregressive decoding), so there is exactly one set of semantics;
+- attention context (AdditiveAttention over the encoder memory) replaces
+  the reference's constant mean-pooled feature; ``use_attention=False``
+  recovers the reference's pooled behavior exactly (context = pooled
+  feature each step);
+- carries are (c, h) tuples per layer — a pytree that shards trivially
+  over the data mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import AdditiveAttention
+
+Carry = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]  # ((c, h) per layer)
+
+
+class DecoderCell(nn.Module):
+    """One decode step: embed token, attend, run LSTM stack, emit logits."""
+
+    vocab_size: int          # with PAD/EOS row: len(vocab) + 1
+    embed_size: int
+    hidden_size: int
+    num_layers: int = 1
+    attn_size: int = 512
+    use_attention: bool = True
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        carry: Carry,
+        token: jnp.ndarray,        # (B,) int32
+        memory: jnp.ndarray,       # (B, T, H)
+        proj_mem: jnp.ndarray,     # (B, T, A)
+        pooled: jnp.ndarray,       # (B, H)
+        train: bool = False,
+    ):
+        x = nn.Embed(self.vocab_size, self.embed_size, dtype=self.dtype,
+                     name="embed")(token)
+        h_top = carry[-1][1]
+        if self.use_attention:
+            context, _ = AdditiveAttention(self.attn_size, dtype=self.dtype,
+                                           name="attn")(h_top, memory, proj_mem)
+        else:
+            context = pooled
+        inp = jnp.concatenate([x, context.astype(self.dtype)], axis=-1)
+        new_carry = []
+        for layer in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype,
+                                        name=f"lstm{layer}")
+            layer_carry, inp = cell(carry[layer], inp)
+            new_carry.append(layer_carry)
+        h = inp
+        if self.dropout_rate > 0:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="logit")(h)
+        return tuple(new_carry), logits
+
+
+def scan_decoder(cell_cls=DecoderCell):
+    """nn.scan-transformed DecoderCell: tokens (B, L) -> logits (B, L, V).
+
+    Params broadcast across time (one weight set), dropout rng split per
+    step.  Single-step decoding is the L=1 case of the same transform, so
+    training and sampling can never diverge.
+    """
+    return nn.scan(
+        cell_cls,
+        variable_broadcast="params",
+        split_rngs={"params": False, "dropout": True},
+        in_axes=(1, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+        out_axes=1,
+    )

@@ -1,0 +1,103 @@
+"""Transformer caption decoder — driver config 5's decoder swap.
+
+For ActivityNet-length feature streams the LSTM's sequential carry wastes
+the MXU; a causal Transformer decoder computes the whole teacher-forced
+sequence as batched matmuls (SURVEY.md §6 config ladder: "Transformer-
+decoder swap at pod scale").  Pre-LN blocks: causal self-attention over
+the word prefix, cross-attention over the encoder memory, MLP.
+
+Autoregressive decoding reuses the same parallel forward over a static
+token buffer (carry = (buffer, position)): step t writes the token at
+position t and reads logits at t.  That is O(L^2) per caption — for
+caption lengths (<=30 tokens) this costs less than maintaining a KV cache
+and keeps ONE forward implementation for train and decode.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+TxCarry = Tuple[jnp.ndarray, jnp.ndarray]  # (token buffer (B, Lmax), position ())
+
+
+class TransformerBlock(nn.Module):
+    hidden_size: int
+    num_heads: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, memory, causal_mask, train: bool = False):
+        deterministic = not train
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, name="self_attn",
+        )(y, y, mask=causal_mask, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, name="cross_attn",
+        )(y, memory, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(4 * self.hidden_size, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden_size, dtype=self.dtype)(y)
+        if self.dropout_rate > 0:
+            y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        return x + y
+
+
+class TransformerDecoder(nn.Module):
+    vocab_size: int
+    embed_size: int = 512
+    hidden_size: int = 512
+    num_layers: int = 2
+    num_heads: int = 8
+    dropout_rate: float = 0.0
+    max_len: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jnp.ndarray, memory: jnp.ndarray,
+                 pooled: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        """Teacher-forced parallel decode: (B, L) tokens -> (B, L, V) logits."""
+        b, length = inputs.shape
+        if length > self.max_len:
+            raise ValueError(f"sequence {length} exceeds max_len {self.max_len}")
+        x = nn.Embed(self.vocab_size, self.hidden_size, dtype=self.dtype,
+                     name="embed")(inputs)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_len, self.hidden_size), self.dtype)
+        # The fused video feature seeds every position (the transformer
+        # analogue of the LSTM's feature-initialized state).
+        x = x + pos[None, :length, :] + pooled[:, None, :].astype(self.dtype)
+        causal = nn.make_causal_mask(inputs)
+        for layer in range(self.num_layers):
+            x = TransformerBlock(self.hidden_size, self.num_heads,
+                                 self.dropout_rate, self.dtype,
+                                 name=f"block_{layer}")(x, memory, causal, train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype, name="logit")(x)
+
+    def decode(self, carry: TxCarry, tokens: jnp.ndarray, memory: jnp.ndarray,
+               pooled: jnp.ndarray, train: bool = False):
+        """Autoregressive step(s) over a static buffer.
+
+        tokens (B, L): written into the buffer at [pos, pos+L); returns
+        logits for those positions.  With L==1 this is the sampler step.
+        """
+        buf, pos = carry
+        b, l = tokens.shape
+        buf = jax.lax.dynamic_update_slice(buf, tokens, (0, pos))
+        logits_all = self(buf, memory, pooled, train=train)
+        logits = jax.lax.dynamic_slice(
+            logits_all, (0, pos, 0), (b, l, logits_all.shape[-1])
+        )
+        return (buf, pos + l), logits

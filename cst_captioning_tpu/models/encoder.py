@@ -1,0 +1,59 @@
+"""Multi-modality feature encoder.
+
+The reference encoder (SURVEY.md §2 "Captioning model") linearly embeds each
+modality's pre-extracted features, mean-pools over time, and concatenates
+modalities.  Rebuilt TPU-first:
+
+- every modality is projected to a shared hidden size with one Dense
+  (an MXU matmul over the batch*time axis);
+- the *pooled* path (mean over time, concat, fuse) initializes the decoder
+  state — the reference's only path;
+- additionally the per-timestep projections are concatenated along time
+  into an attention memory (B, sum_m T_m, H) for the attention-LSTM and
+  Transformer decoders, which the reference's mean-pool destroyed — this is
+  the "attention-LSTM decoder" of the north-star and the path that scales
+  to ActivityNet-length feature streams (SURVEY.md §5 long-context).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FeatureEncoder(nn.Module):
+    """Project + fuse per-modality features.
+
+    Returns (memory, pooled):
+      memory: (B, sum_m T_m, hidden) per-timestep encodings for attention
+      pooled: (B, hidden) fused global feature for decoder-state init
+    """
+
+    hidden_size: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, feats: Sequence[jnp.ndarray], train: bool = False):
+        if len(feats) == 0:
+            raise ValueError("need at least one feature modality")
+        projected: List[jnp.ndarray] = []
+        pooled: List[jnp.ndarray] = []
+        for m, x in enumerate(feats):
+            if x.ndim != 3:
+                raise ValueError(f"modality {m}: expected (B, T, D), got {x.shape}")
+            x = x.astype(self.dtype)
+            h = nn.Dense(self.hidden_size, dtype=self.dtype, name=f"embed_{m}")(x)
+            h = nn.relu(h)
+            projected.append(h)                    # (B, T_m, H)
+            pooled.append(jnp.mean(h, axis=1))     # (B, H)
+        memory = jnp.concatenate(projected, axis=1)
+        fused = jnp.concatenate(pooled, axis=-1)
+        fused = nn.Dense(self.hidden_size, dtype=self.dtype, name="fuse")(fused)
+        fused = nn.tanh(fused)
+        if self.dropout_rate > 0:
+            fused = nn.Dropout(self.dropout_rate, deterministic=not train)(fused)
+            memory = nn.Dropout(self.dropout_rate, deterministic=not train)(memory)
+        return memory, fused

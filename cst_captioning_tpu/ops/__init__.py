@@ -1,0 +1,12 @@
+"""TPU compute ops: attention, losses, sampling, beam search."""
+
+from .attention import AdditiveAttention
+from .losses import cross_entropy_loss, reward_loss, sequence_mask, token_logprobs
+
+__all__ = [
+    "AdditiveAttention",
+    "cross_entropy_loss",
+    "reward_loss",
+    "sequence_mask",
+    "token_logprobs",
+]

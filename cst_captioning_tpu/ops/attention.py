@@ -1,0 +1,41 @@
+"""Attention primitives for the caption decoders.
+
+Additive (Bahdanau) attention for the attention-LSTM decoder — the
+north-star architecture ("feature encoder and attention-LSTM decoder",
+BASELINE.json) — expressed as pure batched tensor ops so XLA fuses the
+score computation into MXU matmuls + a softmax, with no per-step Python.
+
+Split for the scan: the memory projection (W_m · memory) depends only on the
+encoder output, so the *caller* computes it once per sequence with a plain
+``nn.Dense`` and passes it into every step; this module holds only the
+per-step parameters (query projection + score vector), keeping the inner
+decode loop at one (B,H)x(H,A) matmul.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AdditiveAttention(nn.Module):
+    """score(h, m_t) = v . tanh(proj_mem_t + W_q h); returns (context, weights)."""
+
+    attn_size: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        query: jnp.ndarray,             # (B, H) decoder state
+        memory: jnp.ndarray,            # (B, T, H) encoder output
+        projected_memory: jnp.ndarray,  # (B, T, A) precomputed W_m . memory
+    ):
+        q = nn.Dense(self.attn_size, use_bias=False, dtype=self.dtype,
+                     name="query_proj")(query)[:, None, :]           # (B, 1, A)
+        scores = nn.Dense(1, use_bias=False, dtype=self.dtype, name="score")(
+            jnp.tanh(projected_memory + q)
+        )[..., 0]                                                     # (B, T)
+        weights = nn.softmax(scores, axis=-1)
+        context = jnp.einsum("bt,bth->bh", weights, memory.astype(self.dtype))
+        return context, weights

@@ -1,0 +1,70 @@
+"""Sequence losses: masked XE, consensus-weighted XE, REINFORCE.
+
+Pure functions over (logits, labels, ...) — the reference's
+``CrossEntropyCriterion`` / ``RewardCriterion`` modules (SURVEY.md §2)
+become jit-compatible functions with no state, differentiable end to end.
+
+Masking convention (matches the reference's 0=EOS labels): position t is
+supervised iff every earlier target token is nonzero — i.e. tokens up to
+AND INCLUDING the first 0 (the model must learn to emit EOS), everything
+after is padding.  Implemented with a cumulative product, no Python loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_mask(targets: jnp.ndarray) -> jnp.ndarray:
+    """(N, L) 0-terminated targets -> float mask covering words + first EOS.
+
+    mask[:, 0] = 1 always; mask[:, t] = all(targets[:, :t] != 0).
+    """
+    nonzero = (targets != 0).astype(jnp.float32)
+    leading = jnp.cumprod(nonzero[:, :-1], axis=1)
+    return jnp.concatenate(
+        [jnp.ones_like(nonzero[:, :1]), leading], axis=1
+    )
+
+
+def token_logprobs(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """log p(target_t) per position: (N, L, V), (N, L) -> (N, L)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def cross_entropy_loss(
+    logits: jnp.ndarray,                 # (N, L, V)
+    targets: jnp.ndarray,                # (N, L) 0-terminated
+    weights: Optional[jnp.ndarray] = None,  # (N,) per-caption consensus weights
+) -> jnp.ndarray:
+    """Masked sequence XE; with ``weights`` this is the WXE criterion
+    (per-caption scalar multiplies that caption's token losses).
+
+    Normalized by the *unweighted* mask total so XE and WXE are on the same
+    scale (normalize_weights keeps mean weight at 1), and learning rates
+    transfer between the XE -> WXE stages.
+    """
+    mask = sequence_mask(targets)
+    nll = -token_logprobs(logits, targets) * mask
+    if weights is not None:
+        nll = nll * weights[:, None]
+    return nll.sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def reward_loss(
+    sample_logprobs: jnp.ndarray,        # (N, L) log p of the sampled tokens
+    sampled: jnp.ndarray,                # (N, L) sampled token ids, 0-terminated
+    advantage: jnp.ndarray,              # (N,) reward - baseline, no gradient
+) -> jnp.ndarray:
+    """REINFORCE: -E[advantage * log p(sampled)], masked to the sampled
+    sequence (words + first EOS).  ``advantage`` is treated as a constant
+    (stop_gradient), matching the reference RewardCriterion semantics.
+    """
+    mask = sequence_mask(sampled)
+    adv = jax.lax.stop_gradient(advantage)[:, None]
+    loss = -(sample_logprobs * adv * mask)
+    return loss.sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,15 +1,43 @@
-"""Test harness config: force an 8-device virtual CPU mesh.
+"""Test harness config: force a local 8-device virtual CPU mesh.
 
 Tests never touch the real TPU chip (driver config 1 is a CPU smoke test —
 SURVEY.md §4); multi-device sharding tests run on XLA's host-platform
-virtual devices.  Must run before jax is imported anywhere.
+virtual devices.
+
+Subtlety: this session's interpreter boots with an `.axon_site`
+sitecustomize that imports jax and registers the remote-TPU "axon" PJRT
+plugin *before* conftest runs, with JAX_PLATFORMS=axon and remote XLA
+compilation over a tunnel.  Setting env vars here is therefore too late —
+jax has already read them — so we must (a) update jax's config directly and
+(b) deregister the axon backend factory so `backends()` never initializes
+the tunnel client (which blocks indefinitely when the tunnel is down, and
+routes every test compile through the wire even when it is up).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Env vars still matter for any subprocess the tests spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # deregister the axon remote-TPU plugin if sitecustomize installed it
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals moved; cpu config above still holds
+    pass
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + repr(jax.devices())
+)
+assert jax.device_count() >= 8, (
+    "xla_force_host_platform_device_count did not take effect: "
+    f"{jax.device_count()} devices"
+)

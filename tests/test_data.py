@@ -223,3 +223,20 @@ class TestReviewRegressions:
                 break
             time.sleep(0.05)
         assert threading.active_count() <= before
+
+    def test_zero_caption_video_rejected_at_prepro(self, tmp_path):
+        from cst_captioning_tpu.data.prepro import build_split
+        with pytest.raises(ValueError, match="zero captions"):
+            build_split([{"id": "v0", "captions": []}], str(tmp_path), "train")
+
+    def test_model_tx_max_len_plumbed(self):
+        import jax
+        import jax.numpy as jnp
+        from cst_captioning_tpu.models import CaptionModel
+        m = CaptionModel(vocab_size=8, embed_size=8, hidden_size=8,
+                         decoder_type="transformer", num_heads=2,
+                         tx_max_len=96, dropout_rate=0.0)
+        feats = [jnp.ones((1, 2, 4))]
+        labels = jnp.zeros((1, 80), jnp.int32)
+        v = m.init(jax.random.key(0), feats, labels)
+        assert m.apply(v, feats, labels).shape == (1, 80, 8)

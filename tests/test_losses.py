@@ -1,0 +1,99 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.ops.losses import (
+    cross_entropy_loss,
+    reward_loss,
+    sequence_mask,
+    token_logprobs,
+)
+
+
+class TestSequenceMask:
+    def test_covers_words_and_first_eos(self):
+        targets = jnp.array([[3, 5, 0, 0], [1, 2, 3, 4], [0, 0, 0, 0]])
+        mask = sequence_mask(targets)
+        np.testing.assert_array_equal(
+            mask, [[1, 1, 1, 0], [1, 1, 1, 1], [1, 0, 0, 0]]
+        )
+
+
+class TestCrossEntropy:
+    def test_perfect_prediction_near_zero(self):
+        targets = jnp.array([[2, 1, 0]])
+        logits = jnp.full((1, 3, 4), -1e9).at[0, 0, 2].set(0.0)
+        logits = logits.at[0, 1, 1].set(0.0).at[0, 2, 0].set(0.0)
+        assert cross_entropy_loss(logits, targets) < 1e-3
+
+    def test_uniform_prediction_log_vocab(self):
+        targets = jnp.array([[2, 1, 0]])
+        logits = jnp.zeros((1, 3, 4))
+        assert cross_entropy_loss(logits, targets) == pytest.approx(np.log(4), rel=1e-5)
+
+    def test_padding_excluded(self):
+        targets = jnp.array([[2, 0, 0, 0]])
+        good = jnp.zeros((1, 4, 4))
+        # garbage at padded positions must not change the loss
+        bad = good.at[0, 2:, :].set(jnp.array([100.0, -50.0, 3.0, 7.0]))
+        assert cross_entropy_loss(good, targets) == pytest.approx(
+            float(cross_entropy_loss(bad, targets)), rel=1e-6
+        )
+
+    def test_weights_scale_per_caption(self):
+        targets = jnp.array([[2, 0], [3, 0]])
+        logits = jnp.zeros((2, 2, 4))
+        base = cross_entropy_loss(logits, targets)
+        # doubling one caption's weight moves the loss up (same mask norm)
+        w = cross_entropy_loss(logits, targets, weights=jnp.array([2.0, 1.0]))
+        assert w == pytest.approx(float(base) * 1.5, rel=1e-5)
+
+    def test_gradient_flows(self):
+        targets = jnp.array([[2, 1, 0]])
+        g = jax.grad(lambda l: cross_entropy_loss(l, targets))(jnp.zeros((1, 3, 4)))
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+class TestRewardLoss:
+    def test_positive_advantage_pushes_up_logprob(self):
+        sampled = jnp.array([[2, 3, 0]])
+        adv = jnp.array([1.0])
+
+        def loss_of(lp_scale):
+            lp = jnp.full((1, 3), lp_scale)
+            return reward_loss(lp, sampled, adv)
+
+        # higher logprob of the sampled tokens -> lower loss
+        assert loss_of(-0.1) < loss_of(-2.0)
+
+    def test_zero_advantage_zero_loss(self):
+        lp = jnp.full((1, 3), -1.0)
+        sampled = jnp.array([[2, 3, 0]])
+        assert reward_loss(lp, sampled, jnp.array([0.0])) == 0.0
+
+    def test_advantage_gets_no_gradient(self):
+        sampled = jnp.array([[2, 0]])
+
+        def f(adv):
+            return reward_loss(jnp.full((1, 2), -1.0), sampled, adv)
+
+        g = jax.grad(f)(jnp.array([1.5]))
+        np.testing.assert_array_equal(np.asarray(g), [0.0])
+
+    def test_mask_limits_to_sampled_length(self):
+        sampled = jnp.array([[2, 0, 0, 0]])
+        lp_short = jnp.array([[-1.0, -1.0, 0.0, 0.0]])
+        lp_junk = jnp.array([[-1.0, -1.0, -99.0, -42.0]])
+        a = reward_loss(lp_short, sampled, jnp.array([1.0]))
+        b = reward_loss(lp_junk, sampled, jnp.array([1.0]))
+        assert a == pytest.approx(float(b))
+
+
+class TestTokenLogprobs:
+    def test_matches_manual(self):
+        logits = jnp.array([[[1.0, 2.0, 0.5]]])
+        targets = jnp.array([[1]])
+        expected = jax.nn.log_softmax(logits[0, 0])[1]
+        assert token_logprobs(logits, targets)[0, 0] == pytest.approx(float(expected))
