@@ -27,6 +27,7 @@ from typing import List, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.sampling import repeat_for_captions  # noqa: F401  (re-export)
 from .decoder_lstm import Carry, DecoderCell, scan_decoder
 from .decoder_transformer import TransformerDecoder
 from .encoder import FeatureEncoder
@@ -37,13 +38,6 @@ def shift_right(labels: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate(
         [jnp.zeros_like(labels[:, :1]), labels[:, :-1]], axis=1
     )
-
-
-def repeat_for_captions(x: jnp.ndarray, seq_per_img: int) -> jnp.ndarray:
-    """(B, ...) -> (B*S, ...): align per-video encodings with per-caption rows."""
-    if seq_per_img == 1:
-        return x
-    return jnp.repeat(x, seq_per_img, axis=0)
 
 
 class CaptionModel(nn.Module):

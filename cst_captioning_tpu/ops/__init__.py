@@ -1,12 +1,28 @@
 """TPU compute ops: attention, losses, sampling, beam search."""
 
 from .attention import AdditiveAttention
+from .beam import beam_search, beam_search_tokens, jit_beam_search
 from .losses import cross_entropy_loss, reward_loss, sequence_mask, token_logprobs
+from .sampling import (
+    greedy_decode,
+    jit_sampler,
+    make_decode_step,
+    sample_captions,
+    sample_tokens,
+)
 
 __all__ = [
     "AdditiveAttention",
+    "beam_search",
+    "beam_search_tokens",
     "cross_entropy_loss",
+    "greedy_decode",
+    "jit_beam_search",
+    "jit_sampler",
+    "make_decode_step",
     "reward_loss",
+    "sample_captions",
+    "sample_tokens",
     "sequence_mask",
     "token_logprobs",
 ]

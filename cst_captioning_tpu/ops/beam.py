@@ -1,0 +1,167 @@
+"""Batched beam search as one compiled XLA program.
+
+The reference's ``sample_beam`` (SURVEY.md §3.3) loops in Python per video:
+expand state ×k, step the LSTM, topk over (beam × vocab), reorder states,
+collect finished hypotheses.  That shape — data-dependent control flow per
+item — is exactly what kills TPU utilization, so here the WHOLE batch of
+beams advances in a single ``lax.scan``:
+
+- decoder state lives as a pytree with leading dim ``B*k``; beam reordering
+  is a batched gather over that axis (scalar leaves, e.g. the transformer
+  position counter, pass through untouched);
+- finished beams are forced to extend with EOS (id 0) at zero cost, so
+  token buffers stay 0-padded in the label convention and no per-item
+  "collect at EOS" bookkeeping exists;
+- step 0 masks beams 1..k-1 to -inf so the k initial hypotheses are the k
+  distinct top tokens, not k copies;
+- ranking uses optional length normalization ``score / len**alpha``
+  (alpha=0 reproduces raw total-logprob ranking; the reference's
+  normalization behavior is unverified [SURVEY.md §7 hard part (c)] so it
+  is a flag, default off).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import make_decode_step
+
+NEG_INF = -1e9
+
+
+def _expand_to_beams(tree, beam_size: int, batch: int):
+    """Tile each (B, ...) leaf to (B*k, ...); leave scalar leaves alone."""
+
+    def tile(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch:
+            return jnp.repeat(x, beam_size, axis=0)
+        return x
+
+    return jax.tree_util.tree_map(tile, tree)
+
+
+def _reorder_beams(tree, parent: jnp.ndarray, batch: int, beam_size: int):
+    """Gather (B*k, ...) leaves by per-batch parent beam index (B, k)."""
+    flat_ix = (
+        jnp.arange(batch)[:, None] * beam_size + parent
+    ).reshape(-1)                                            # (B*k,)
+
+    def gather(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch * beam_size:
+            return jnp.take(x, flat_ix, axis=0)
+        return x
+
+    return jax.tree_util.tree_map(gather, tree)
+
+
+def beam_search_tokens(
+    step: Callable,
+    init_carry,
+    batch: int,
+    beam_size: int,
+    max_len: int,
+    length_norm: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run beam search over a bound decode ``step``.
+
+    ``init_carry`` must already be expanded to ``B*k`` rows (use
+    ``_expand_to_beams``).  Returns (best (B, L), all_beams (B, k, L),
+    scores (B, k)) with beams sorted best-first.
+    """
+    k = beam_size
+
+    def body(state, t):
+        carry, prev, scores, finished, lengths = state
+        carry, logits = step(carry, prev.reshape(-1))         # (B*k, V)
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(batch, k, vocab)
+        # Finished beams: only EOS continues, at zero cost.
+        eos_only = jnp.full((vocab,), NEG_INF).at[0].set(0.0)
+        logp = jnp.where(finished[:, :, None], eos_only[None, None, :], logp)
+        # Step 0: all beams share the same state; keep only beam 0 live.
+        init_mask = jnp.where(
+            (t == 0) & (jnp.arange(k) > 0), NEG_INF, 0.0
+        )
+        total = scores[:, :, None] + logp + init_mask[None, :, None]
+        total = total.reshape(batch, k * vocab)
+        new_scores, flat = jax.lax.top_k(total, k)            # (B, k)
+        parent = flat // vocab
+        token = (flat % vocab).astype(jnp.int32)
+        carry = _reorder_beams(carry, parent, batch, k)
+        was_finished = jnp.take_along_axis(finished, parent, axis=1)
+        lengths = jnp.take_along_axis(lengths, parent, axis=1)
+        lengths = lengths + jnp.where(was_finished, 0, 1)     # count incl. EOS
+        finished = was_finished | (token == 0)
+        return (carry, token, new_scores, finished, lengths), (token, parent)
+
+    init = (
+        init_carry,
+        jnp.zeros((batch, k), dtype=jnp.int32),               # BOS
+        jnp.zeros((batch, k)),
+        jnp.zeros((batch, k), dtype=bool),
+        jnp.zeros((batch, k), dtype=jnp.int32),
+    )
+    (_, _, scores, _, lengths), (tokens, parents) = jax.lax.scan(
+        body, init, jnp.arange(max_len)
+    )
+    # Backtrack (L, B, k) token/parent chains into (B, k, L) sequences.
+    def back(beam_ix, tp):                                     # beam_ix (B, k)
+        tok_t, par_t = tp                                      # each (B, k)
+        toks = jnp.take_along_axis(tok_t, beam_ix, axis=1)
+        beam_ix = jnp.take_along_axis(par_t, beam_ix, axis=1)
+        return beam_ix, toks
+
+    # Walk from the last step to the first; tokens come out reversed.
+    last_ix = jnp.tile(jnp.arange(k)[None, :], (batch, 1))
+    _, rev = jax.lax.scan(back, last_ix, (tokens[::-1], parents[::-1]))
+    seqs = rev[::-1].transpose(1, 2, 0)                        # (B, k, L)
+
+    ranked = scores
+    if length_norm > 0:
+        ranked = scores / jnp.maximum(lengths, 1) ** length_norm
+    order = jnp.argsort(-ranked, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    ranked = jnp.take_along_axis(ranked, order, axis=1)
+    return seqs[:, 0, :], seqs, ranked
+
+
+def beam_search(
+    model,
+    variables,
+    feats: Sequence[jnp.ndarray],
+    beam_size: int,
+    max_len: int,
+    length_norm: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Encode + beam-decode a batch of videos.
+
+    -> (best (B, L) 0-terminated, all beams (B, k, L), scores (B, k)).
+    """
+    memory, proj_mem, pooled = model.apply(
+        variables, feats, method="encode"
+    )
+    batch = pooled.shape[0]
+    memory, proj_mem, pooled = _expand_to_beams(
+        (memory, proj_mem, pooled), beam_size, batch
+    )
+    carry = model.apply(
+        variables, pooled, max_len, method="init_carry"
+    )
+    step = make_decode_step(model, variables, memory, proj_mem, pooled)
+    return beam_search_tokens(step, carry, batch, beam_size, max_len,
+                              length_norm=length_norm)
+
+
+def jit_beam_search(model, beam_size: int, max_len: int,
+                    length_norm: float = 0.0):
+    """jit-compiled beam search: (variables, feats) -> (best, beams, scores)."""
+
+    @jax.jit
+    def fn(variables, feats):
+        return beam_search(model, variables, feats, beam_size, max_len,
+                           length_norm=length_norm)
+
+    return fn

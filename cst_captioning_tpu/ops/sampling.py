@@ -1,0 +1,158 @@
+"""Greedy / multinomial caption sampling — one compiled `lax.scan`.
+
+The reference's ``model.sample`` (SURVEY.md §2 "Captioning model") runs a
+Python loop of per-step LSTM calls with ``torch.multinomial`` on device,
+flag-switched between argmax (``sample_max=1``) and multinomial rollout
+(``sample_max=0``).  TPU-first restatement:
+
+- the whole rollout is ONE ``lax.scan`` over the model's ``decode`` step —
+  traced once, compiled once, no Python-per-timestep dispatch;
+- greedy vs multinomial is a static flag (two jit specializations);
+- ``jax.random.categorical`` replaces torch.multinomial; the key is split
+  per step inside the scan;
+- sequences are 0-terminated to match the label convention
+  (``ops.losses.sequence_mask``): the first sampled EOS (id 0) is kept,
+  everything after is forced to 0 with logprob 0.
+
+Gradient note: rollouts are sampling-only (no grad).  The RL stage
+recomputes log p(sampled) with the teacher-forced ``model.__call__`` under
+``jax.grad`` — the reference instead kept the rollout graph alive
+(SURVEY.md §3.2); recomputation is the XLA-native equivalent and lets the
+rollout run in a fused scan without storing activations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# NOTE: the model is referenced only through its method NAMES ("decode",
+# "encode", "init_carry") to keep ops <-> models import-acyclic; any module
+# exposing those three surfaces works (CaptionModel is the one that does).
+
+
+def repeat_for_captions(x: jnp.ndarray, seq_per_img: int) -> jnp.ndarray:
+    """(B, ...) -> (B*S, ...): align per-video encodings with caption rows."""
+    if seq_per_img == 1:
+        return x
+    return jnp.repeat(x, seq_per_img, axis=0)
+
+
+def make_decode_step(
+    model,
+    variables,
+    memory: jnp.ndarray,
+    proj_mem: jnp.ndarray,
+    pooled: jnp.ndarray,
+) -> Callable:
+    """Bind encodings + params into a pure per-step function.
+
+    Returned ``step(carry, token(N,)) -> (carry, logits (N, V))`` is what
+    both the samplers and the beam search drive.
+    """
+
+    def step(carry, token):
+        carry, logits = model.apply(
+            variables, carry, token[:, None], memory, proj_mem, pooled,
+            method="decode",
+        )
+        return carry, logits[:, 0, :]
+
+    return step
+
+
+def sample_tokens(
+    step: Callable,
+    init_carry,
+    batch: int,
+    max_len: int,
+    rng: jax.Array,
+    greedy: bool = False,
+    temperature: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Roll out ``max_len`` steps from BOS (=0).
+
+    Returns (tokens (N, L) int32 0-terminated, logprobs (N, L) float32 of
+    the emitted tokens, 0 past the first EOS).
+    """
+
+    def body(state, key):
+        carry, prev, finished = state
+        carry, logits = step(carry, prev)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = jax.random.categorical(
+                key, logits / jnp.maximum(temperature, 1e-6), axis=-1
+            ).astype(jnp.int32)
+        tok_logp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        emit = jnp.where(finished, 0, nxt)
+        emit_logp = jnp.where(finished, 0.0, tok_logp)
+        finished = finished | (emit == 0)
+        return (carry, emit, finished), (emit, emit_logp)
+
+    keys = jax.random.split(rng, max_len)
+    init = (
+        init_carry,
+        jnp.zeros((batch,), dtype=jnp.int32),        # BOS
+        jnp.zeros((batch,), dtype=bool),
+    )
+    _, (tokens, logprobs) = jax.lax.scan(body, init, keys)
+    return tokens.T, logprobs.T                       # (L, N) -> (N, L)
+
+
+def sample_captions(
+    model,
+    variables,
+    feats: Sequence[jnp.ndarray],
+    rng: jax.Array,
+    max_len: int,
+    seq_per_img: int = 1,
+    greedy: bool = False,
+    temperature: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Encode once, roll out ``seq_per_img`` captions per video.
+
+    -> (tokens (B*seq_per_img, L), logprobs (B*seq_per_img, L)).
+    Greedy rollouts with seq_per_img>1 are identical per video (used with
+    seq_per_img=1 for the SCST baseline / eval decode).
+    """
+    memory, proj_mem, pooled = model.apply(
+        variables, feats, method="encode"
+    )
+    memory = repeat_for_captions(memory, seq_per_img)
+    proj_mem = repeat_for_captions(proj_mem, seq_per_img)
+    pooled = repeat_for_captions(pooled, seq_per_img)
+    n = pooled.shape[0]
+    carry = model.apply(
+        variables, pooled, max_len, method="init_carry"
+    )
+    step = make_decode_step(model, variables, memory, proj_mem, pooled)
+    return sample_tokens(step, carry, n, max_len, rng,
+                         greedy=greedy, temperature=temperature)
+
+
+def greedy_decode(model, variables, feats, max_len: int) -> jnp.ndarray:
+    """Deterministic argmax decode -> (B, L) tokens (eval fast path)."""
+    tokens, _ = sample_captions(
+        model, variables, feats,
+        jax.random.PRNGKey(0), max_len, greedy=True,
+    )
+    return tokens
+
+
+def jit_sampler(model, max_len: int, seq_per_img: int = 1,
+                greedy: bool = False, temperature: float = 1.0):
+    """jit-compiled sampler: (variables, feats, rng) -> (tokens, logprobs)."""
+
+    @jax.jit
+    def fn(variables, feats, rng):
+        return sample_captions(
+            model, variables, feats, rng, max_len,
+            seq_per_img=seq_per_img, greedy=greedy, temperature=temperature,
+        )
+
+    return fn

@@ -1,0 +1,190 @@
+"""Samplers + beam search: shape/termination invariants, greedy-vs-forward
+consistency, and beam search against a brute-force oracle (SURVEY.md §4
+"beam-search against a brute-force reference on tiny vocab")."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.beam import (
+    _expand_to_beams,
+    beam_search,
+    beam_search_tokens,
+)
+from cst_captioning_tpu.ops.losses import sequence_mask, token_logprobs
+from cst_captioning_tpu.ops.sampling import sample_captions, sample_tokens
+
+VOCAB = 12
+B = 3
+T = 5
+D = 7
+MAX_LEN = 6
+
+
+def make_model(decoder_type="lstm", use_attention=True):
+    model = CaptionModel(
+        vocab_size=VOCAB, embed_size=16, hidden_size=16, attn_size=16,
+        use_attention=use_attention, dropout_rate=0.0,
+        decoder_type=decoder_type, num_heads=2, num_tx_layers=1,
+        tx_max_len=MAX_LEN,
+    )
+    feats = [jnp.asarray(np.random.default_rng(0).normal(size=(B, T, D)),
+                         jnp.float32)]
+    labels = jnp.zeros((B, MAX_LEN), dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), feats, labels)
+    return model, variables, feats
+
+
+@pytest.mark.parametrize("decoder_type", ["lstm", "transformer"])
+def test_sample_shapes_and_termination(decoder_type):
+    model, variables, feats = make_model(decoder_type)
+    toks, logps = sample_captions(
+        model, variables, feats, jax.random.PRNGKey(1), MAX_LEN, seq_per_img=2
+    )
+    assert toks.shape == (2 * B, MAX_LEN)
+    assert logps.shape == (2 * B, MAX_LEN)
+    toks = np.asarray(toks)
+    logps = np.asarray(logps)
+    # 0-terminated: after the first 0 everything is 0 with logprob 0.
+    for row_t, row_l in zip(toks, logps):
+        zeros = np.nonzero(row_t == 0)[0]
+        if len(zeros):
+            first = zeros[0]
+            assert (row_t[first:] == 0).all()
+            assert (row_l[first + 1:] == 0).all()
+    # Live logprobs are genuine log-probabilities.
+    mask = np.asarray(sequence_mask(jnp.asarray(toks)))
+    assert (logps[mask.astype(bool)] <= 0).all()
+
+
+@pytest.mark.parametrize("decoder_type", ["lstm", "transformer"])
+def test_greedy_logprobs_match_teacher_forced_forward(decoder_type):
+    """The sampler's per-token logprobs must equal the training forward's —
+    one-semantics guarantee between decode and train paths."""
+    model, variables, feats = make_model(decoder_type)
+    toks, logps = sample_captions(
+        model, variables, feats, jax.random.PRNGKey(2), MAX_LEN, greedy=True
+    )
+    logits = model.apply(variables, feats, toks, seq_per_img=1)
+    tf_logps = token_logprobs(logits, toks)
+    mask = sequence_mask(toks)
+    np.testing.assert_allclose(
+        np.asarray(logps * mask), np.asarray(tf_logps * mask),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_multinomial_differs_across_keys_greedy_does_not():
+    model, variables, feats = make_model()
+    g1, _ = sample_captions(model, variables, feats, jax.random.PRNGKey(1),
+                            MAX_LEN, greedy=True)
+    g2, _ = sample_captions(model, variables, feats, jax.random.PRNGKey(9),
+                            MAX_LEN, greedy=True)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+    draws = [
+        np.asarray(sample_captions(model, variables, feats,
+                                   jax.random.PRNGKey(k), MAX_LEN)[0])
+        for k in range(4)
+    ]
+    assert any(not np.array_equal(draws[0], d) for d in draws[1:])
+
+
+class FixedStep:
+    """Deterministic decode 'model': logits depend on (prev token, step) via
+    a fixed table, state counts steps.  Lets brute force enumerate exactly."""
+
+    def __init__(self, vocab, max_len, seed=0):
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(
+            rng.normal(size=(max_len, vocab, vocab)).astype(np.float32)
+        )
+
+    def __call__(self, carry, token):
+        t = carry
+        logits = self.table[t][token]            # (N, V)
+        return t + 1, logits
+
+    def logp(self, t, prev, nxt):
+        row = np.asarray(jax.nn.log_softmax(self.table[t][prev]))
+        return row[nxt]
+
+
+def brute_force_best(step: FixedStep, vocab: int, max_len: int):
+    """Enumerate all 0-terminated sequences; return (best_seq, best_logp)."""
+    best, best_score = None, -np.inf
+    for seq in itertools.product(range(vocab), repeat=max_len):
+        # canonicalize: nothing after first 0
+        arr = list(seq)
+        if 0 in arr:
+            first = arr.index(0)
+            if any(x != 0 for x in arr[first:]):
+                continue  # non-canonical duplicate
+        score, prev = 0.0, 0
+        for t, tok in enumerate(arr):
+            score += step.logp(t, prev, tok)
+            prev = tok
+            if tok == 0:
+                break
+        if score > best_score:
+            best_score, best = score, arr
+    return np.array(best), best_score
+
+
+def test_beam_matches_brute_force_on_tiny_vocab():
+    vocab, max_len = 4, 4
+    step = FixedStep(vocab, max_len, seed=3)
+    oracle_seq, oracle_score = brute_force_best(step, vocab, max_len)
+    # Wide beam == exhaustive on this tiny space.
+    best, beams, scores = beam_search_tokens(
+        step, jnp.zeros((), jnp.int32), batch=1, beam_size=vocab ** 2,
+        max_len=max_len,
+    )
+    np.testing.assert_array_equal(np.asarray(best)[0], oracle_seq)
+    assert np.isclose(float(scores[0, 0]), oracle_score, atol=1e-4)
+
+
+def test_beam_scores_sorted_and_padded():
+    model, variables, feats = make_model()
+    best, beams, scores = beam_search(model, variables, feats,
+                                      beam_size=3, max_len=MAX_LEN)
+    assert best.shape == (B, MAX_LEN)
+    assert beams.shape == (B, 3, MAX_LEN)
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all()
+    toks = np.asarray(beams).reshape(-1, MAX_LEN)
+    for row in toks:
+        zeros = np.nonzero(row == 0)[0]
+        if len(zeros):
+            assert (row[zeros[0]:] == 0).all()
+
+
+def test_beam_size_one_equals_greedy():
+    model, variables, feats = make_model()
+    greedy, _ = sample_captions(model, variables, feats,
+                                jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    best, _, _ = beam_search(model, variables, feats, beam_size=1,
+                             max_len=MAX_LEN)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(best))
+
+
+@pytest.mark.parametrize("decoder_type", ["lstm", "transformer"])
+def test_beam_improves_or_matches_greedy_logprob(decoder_type):
+    """Beam-5's top hypothesis must score >= greedy under the model."""
+    model, variables, feats = make_model(decoder_type)
+    greedy, glogp = sample_captions(model, variables, feats,
+                                    jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    _, _, scores = beam_search(model, variables, feats, beam_size=5,
+                               max_len=MAX_LEN)
+    gscore = np.asarray((glogp * sequence_mask(greedy)).sum(axis=1))
+    assert (np.asarray(scores[:, 0]) >= gscore - 1e-4).all()
+
+
+def test_expand_to_beams_skips_scalars():
+    tree = (jnp.ones((2, 3)), jnp.zeros((), jnp.int32))
+    out = _expand_to_beams(tree, 4, 2)
+    assert out[0].shape == (8, 3)
+    assert out[1].shape == ()
