@@ -1,0 +1,88 @@
+# Experiment driver — the reference Makefile's role (SURVEY.md §2 L6):
+# one target per stage, chained via --start_from, plus prepro/eval/bench.
+#
+# Real-data usage: point DATA at a directory holding the artifacts the
+# prepro CLI builds (train/val/test {feat h5s, label h5, info json, cocofmt
+# json} + train {ciderdf, consensus} pickles), set FEATS to the modality h5
+# basenames, then `make xe wxe cst eval`.
+#
+# Zero-setup demo: `make demo` synthesizes a tiny dataset and runs the full
+# XE -> WXE -> CST -> beam-eval pipeline on it (CPU-friendly).
+
+PY        ?= python
+DATA      ?= data
+OUT       ?= checkpoints
+EXP       ?= msrvtt
+FEATS     ?= $(DATA)/train_resnet_feat.h5 $(DATA)/train_c3d_feat.h5
+VAL_FEATS ?= $(DATA)/val_resnet_feat.h5 $(DATA)/val_c3d_feat.h5
+TEST_FEATS?= $(DATA)/test_resnet_feat.h5 $(DATA)/test_c3d_feat.h5
+BATCH     ?= 64
+SEQ_PER_IMG ?= 20
+BEAM      ?= 5
+
+TRAIN_COMMON = \
+  --train_feat_h5 $(FEATS) \
+  --train_label_h5 $(DATA)/train_label.h5 \
+  --train_info_json $(DATA)/train_info.json \
+  --train_cocofmt_file $(DATA)/train_cocofmt.json \
+  --val_feat_h5 $(VAL_FEATS) \
+  --val_label_h5 $(DATA)/val_label.h5 \
+  --val_info_json $(DATA)/val_info.json \
+  --val_cocofmt_file $(DATA)/val_cocofmt.json \
+  --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
+
+.PHONY: test xe wxe cst cst_scb eval bench demo clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# -- three-stage recipe (XE -> WXE -> CST) --------------------------------
+
+xe:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --checkpoint_path $(OUT)/$(EXP)_xe
+
+wxe:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --start_from $(OUT)/$(EXP)_xe \
+	  --use_consensus_weights 1 \
+	  --train_bcmrscores_pkl $(DATA)/train_consensus.pkl \
+	  --checkpoint_path $(OUT)/$(EXP)_wxe
+
+cst:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --start_from $(OUT)/$(EXP)_wxe \
+	  --use_rl 1 --rl_baseline greedy \
+	  --train_cached_tokens $(DATA)/train_ciderdf.pkl \
+	  --learning_rate 5e-5 \
+	  --checkpoint_path $(OUT)/$(EXP)_cst
+
+cst_scb:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --start_from $(OUT)/$(EXP)_wxe \
+	  --use_rl 1 --rl_baseline scb-gt \
+	  --train_bcmrscores_pkl $(DATA)/train_consensus.pkl \
+	  --train_cached_tokens $(DATA)/train_ciderdf.pkl \
+	  --learning_rate 5e-5 \
+	  --checkpoint_path $(OUT)/$(EXP)_cst_scb
+
+eval:
+	$(PY) eval.py \
+	  --checkpoint_path $(OUT)/$(EXP)_cst \
+	  --test_feat_h5 $(TEST_FEATS) \
+	  --test_label_h5 $(DATA)/test_label.h5 \
+	  --test_info_json $(DATA)/test_info.json \
+	  --test_cocofmt_file $(DATA)/test_cocofmt.json \
+	  --beam_size $(BEAM) \
+	  --result_file $(OUT)/$(EXP)_cst_test_scores.json
+
+bench:
+	$(PY) bench.py --stage xe
+
+# -- zero-setup synthetic demo --------------------------------------------
+
+demo:
+	$(PY) scripts/demo.py --out_dir /tmp/cst_demo
+
+clean:
+	rm -rf $(OUT)

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Throughput benchmark — captions/sec/chip on the XE train step.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "captions/s/chip", "vs_baseline": N}
+
+Baseline: the driver north-star of >= 5000 captions/sec/chip for the XE and
+CST stages on MSR-VTT-shaped data (BASELINE.md; the reference published no
+throughput numbers — SURVEY.md §6).  ``vs_baseline`` is value/5000.
+
+Shapes mirror MSR-VTT training: ResNet-152 (28, 2048) + C3D (1, 4096)
+features, vocab ~8k, 30-token captions, 20 captions/video, attention-LSTM
+decoder (hidden 512).  Data is synthetic and device-resident so the number
+measures the compiled step, not disk IO (the loader's prefetch thread hides
+IO in real training; see cst_captioning_tpu/data/loader.py).
+
+Flags: --stage xe|cst benches the XE step or the full CST iteration
+(rollout + host CIDEr-D reward + REINFORCE grad step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_CAPTIONS_PER_SEC = 5000.0
+
+
+def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
+          hidden: int, use_bfloat16: bool):
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.training.state import create_train_state, make_optimizer
+
+    model = CaptionModel(
+        vocab_size=vocab, embed_size=hidden, hidden_size=hidden,
+        attn_size=hidden, use_attention=True, dropout_rate=0.5,
+        dtype=jnp.bfloat16 if use_bfloat16 else jnp.float32,
+    )
+    tx, _ = make_optimizer(learning_rate=2e-4, grad_clip=10.0)
+    feat_shapes = [(28, 2048), (1, 4096)]
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), feat_shapes, seq_len, seq_per_img, tx,
+        batch_size=batch,
+    )
+    rng = np.random.default_rng(0)
+    feats = [
+        jnp.asarray(rng.standard_normal((batch, t, d)), jnp.float32)
+        for t, d in feat_shapes
+    ]
+    labels = jnp.asarray(
+        rng.integers(1, vocab, (batch * seq_per_img, seq_len)), jnp.int32
+    )
+    # realistic 0-termination: captions average ~10 tokens
+    lens = rng.integers(6, seq_len - 1, batch * seq_per_img)
+    labels = jnp.asarray(np.where(
+        np.arange(seq_len)[None, :] < lens[:, None], np.asarray(labels), 0
+    ), jnp.int32)
+    return model, state, feats, labels
+
+
+def bench_xe(args):
+    from cst_captioning_tpu.training.steps import make_xe_step
+
+    model, state, feats, labels = build(
+        args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+        args.hidden, args.bfloat16,
+    )
+    weights = jnp.ones((args.batch_size * args.seq_per_img,))
+    step = jax.jit(make_xe_step(model, args.seq_per_img), donate_argnums=(0,))
+    rng = jax.random.PRNGKey(0)
+
+    state, m = step(state, feats, labels, weights, rng)       # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = step(state, feats, labels, weights, rng)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return args.batch_size * args.seq_per_img * args.steps / dt
+
+
+def bench_cst(args):
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+    from cst_captioning_tpu.training.rewards import RewardComputer
+    from cst_captioning_tpu.training.steps import make_rl_grad_step, make_rollout
+
+    model, state, feats, labels = build(
+        args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+        args.hidden, args.bfloat16,
+    )
+    vocab = Vocab({i: f"w{i}" for i in range(1, args.vocab)})
+    # synthetic reference corpus: 20 refs per video, ~10 tokens each
+    rng = np.random.default_rng(1)
+    refs = {
+        f"v{i}": [
+            " ".join(f"w{w}" for w in rng.integers(1, args.vocab, 10))
+            for _ in range(20)
+        ]
+        for i in range(args.batch_size)
+    }
+    df, n = build_corpus_df(refs)
+    scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
+                        baseline="greedy")
+    video_ids = list(refs.keys())
+
+    rollout = jax.jit(make_rollout(model, args.seq_len, args.seq_per_img))
+    rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
+                      donate_argnums=(0,))
+
+    def one_iter(state, key):
+        sampled, greedy = rollout(state.params, feats, key)
+        s = np.asarray(jax.device_get(sampled))
+        g = np.asarray(jax.device_get(greedy))
+        adv, _ = rc(video_ids, s, g)
+        state, m = rl_step(state, feats, sampled, jnp.asarray(adv), key)
+        return state, m
+
+    state, m = one_iter(state, jax.random.PRNGKey(0))          # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, m = one_iter(state, jax.random.PRNGKey(i + 1))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return args.batch_size * args.seq_per_img * args.steps / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", default="xe", choices=("xe", "cst"))
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--seq_per_img", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=8000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--bfloat16", type=int, default=1)
+    args = p.parse_args()
+
+    cps = bench_xe(args) if args.stage == "xe" else bench_cst(args)
+    n_chips = max(1, len(jax.devices()))
+    per_chip = cps / n_chips
+    print(json.dumps({
+        "metric": f"{args.stage}_captions_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "captions/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_CAPTIONS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,9 +111,19 @@ def build_split(
         save_corpus_df(df_path, df, ndocs)
         paths["cached_tokens"] = df_path
 
+        # Raw leave-one-out consensus scores (the reference's
+        # --train_bcmrscores_pkl artifact): WXE normalizes them into weights
+        # at train time; the scb-gt RL baseline uses them raw.
+        scores = compute_consensus_scores(tok_refs)
         cons_path = os.path.join(out_dir, f"{split}_consensus.pkl")
-        save_consensus(cons_path, normalize_weights(compute_consensus_scores(tok_refs)))
+        save_consensus(cons_path, scores)
         paths["consensus_pkl"] = cons_path
+
+        # Pre-normalized WXE weights (mean 1 per video) for loaders that
+        # want them without a normalize step.
+        wxe_path = os.path.join(out_dir, f"{split}_wxe_weights.pkl")
+        save_consensus(wxe_path, normalize_weights(scores))
+        paths["wxe_weights_pkl"] = wxe_path
     return paths
 
 

@@ -1,0 +1,156 @@
+"""CLI config — the reference's ``opts.py`` surface, TPU-backed.
+
+One argparse namespace carries every knob (SURVEY.md §2 "CLI config"); flag
+names follow the reference where known (``--train_feat_h5`` multi-valued,
+``--train_label_h5``, ``--*_cocofmt_file``, ``--rnn_size``,
+``--input_encoding_size``, ``--beam_size``, ``--train_cached_tokens``,
+``--train_bcmrscores_pkl``, ``--checkpoint_path``, ``--start_from``,
+``--result_file``, ``--eval_metric``...), with TPU-specific additions
+(mesh size, bfloat16) grouped separately.  The namespace is JSON-serialized
+into checkpoint infos so eval re-reads model hyperparams from the
+checkpoint, not the CLI (SURVEY.md §5 config system).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def _add_data_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("data")
+    for split in ("train", "val", "test"):
+        g.add_argument(f"--{split}_feat_h5", nargs="+", default=None,
+                       help=f"{split} feature h5 files, one per modality")
+        g.add_argument(f"--{split}_label_h5", default=None)
+        g.add_argument(f"--{split}_info_json", default=None,
+                       help="vocab + video-id list for the split")
+        g.add_argument(f"--{split}_cocofmt_file", default=None,
+                       help="coco-format references for metric eval")
+    g.add_argument("--train_cached_tokens", default=None,
+                   help="precomputed CIDEr-D corpus document-frequency pickle")
+    g.add_argument("--train_bcmrscores_pkl", default=None,
+                   help="precomputed per-caption consensus CIDEr scores pickle")
+    g.add_argument("--batch_size", type=int, default=64)
+    g.add_argument("--eval_batch_size", type=int, default=0,
+                   help="0 = use --batch_size")
+    g.add_argument("--seq_per_img", type=int, default=20,
+                   help="captions per video per batch")
+
+
+def _add_model_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("model")
+    g.add_argument("--model_type", default="lstm",
+                   choices=("lstm", "transformer"),
+                   help="decoder family (transformer = driver config 5)")
+    g.add_argument("--rnn_size", type=int, default=512,
+                   help="LSTM hidden size / transformer model dim")
+    g.add_argument("--input_encoding_size", type=int, default=512,
+                   help="word embedding size")
+    g.add_argument("--num_layers", type=int, default=1)
+    g.add_argument("--att_size", type=int, default=512,
+                   help="additive-attention projection size")
+    g.add_argument("--use_attention", type=int, default=1,
+                   help="1 = attention-LSTM; 0 = reference mean-pool model")
+    g.add_argument("--drop_prob", type=float, default=0.5)
+    g.add_argument("--num_heads", type=int, default=8, help="transformer")
+    g.add_argument("--num_tx_layers", type=int, default=2, help="transformer")
+    g.add_argument("--use_bfloat16", type=int, default=0,
+                   help="compute in bfloat16 (MXU-native) with fp32 params")
+
+
+def _add_optim_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("optimization")
+    g.add_argument("--max_epochs", type=int, default=50)
+    g.add_argument("--learning_rate", type=float, default=2e-4)
+    g.add_argument("--optim", default="adam",
+                   choices=("adam", "adamax", "adamw", "rmsprop", "sgd",
+                            "adagrad"))
+    g.add_argument("--grad_clip", type=float, default=10.0,
+                   help="global-norm clip; 0 disables")
+    g.add_argument("--learning_rate_decay_rate", type=float, default=0.8)
+    g.add_argument("--learning_rate_decay_every", type=int, default=3,
+                   help="epochs between staircase lr decays; 0 disables")
+    g.add_argument("--max_patience", type=int, default=5,
+                   help="early-stop epochs without val improvement; 0 = off")
+    g.add_argument("--seed", type=int, default=123)
+
+
+def _add_cst_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("CST / REINFORCE")
+    g.add_argument("--use_rl", type=int, default=0,
+                   help="1 = CST/REINFORCE stage (CIDEr-D reward)")
+    g.add_argument("--rl_baseline", default="greedy",
+                   choices=("greedy", "scb-sample", "scb-gt"),
+                   help="advantage baseline: SCST greedy decode or "
+                        "self-consensus variants (paper's SCB)")
+    g.add_argument("--scb_captions", type=int, default=0,
+                   help="top-k consensus captions for the scb-gt baseline; "
+                        "0 = all")
+    g.add_argument("--temperature", type=float, default=1.0,
+                   help="multinomial sampling temperature")
+    g.add_argument("--use_consensus_weights", type=int, default=0,
+                   help="1 = WXE: weight each caption's XE loss by its "
+                        "consensus score (needs --train_bcmrscores_pkl)")
+    g.add_argument("--consensus_temperature", type=float, default=1.0,
+                   help="softmax temperature for WXE weight normalization")
+
+
+def _add_decode_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("decoding")
+    g.add_argument("--beam_size", type=int, default=5,
+                   help="test-time beam width (1 = greedy)")
+    g.add_argument("--val_beam_size", type=int, default=1,
+                   help="validation decode width (greedy keeps epochs fast)")
+    g.add_argument("--max_length", type=int, default=30,
+                   help="maximum decode length")
+    g.add_argument("--length_norm", type=float, default=0.0,
+                   help="beam score length-normalization exponent; 0 = off")
+
+
+def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("bookkeeping")
+    g.add_argument("--checkpoint_path", default="checkpoints/run",
+                   help="checkpoint directory for this stage")
+    g.add_argument("--start_from", default=None,
+                   help="warm-start params from this stage dir's BEST "
+                        "checkpoint (XE->WXE->CST chaining)")
+    g.add_argument("--result_file", default=None,
+                   help="where eval writes the scores JSON")
+    g.add_argument("--eval_metric", default="CIDEr")
+    g.add_argument("--fast_val", type=int, default=0,
+                   help="1 = validation scores CIDEr only")
+    g.add_argument("--max_checkpoints", type=int, default=2)
+    g.add_argument("--log_every", type=int, default=20, help="steps")
+    g.add_argument("--loglevel", default="INFO")
+
+
+def _add_tpu_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("tpu / parallelism")
+    g.add_argument("--num_devices", type=int, default=0,
+                   help="devices in the data-parallel mesh; 0 = all")
+    g.add_argument("--coordinator_address", default=None,
+                   help="multi-host: jax.distributed coordinator")
+    g.add_argument("--num_processes", type=int, default=0,
+                   help="multi-host: total process count; 0 = single host")
+    g.add_argument("--process_id", type=int, default=0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native consensus-based sequence training "
+                    "for video captioning",
+        fromfile_prefix_chars="@",
+    )
+    _add_data_args(p)
+    _add_model_args(p)
+    _add_optim_args(p)
+    _add_cst_args(p)
+    _add_decode_args(p)
+    _add_bookkeeping_args(p)
+    _add_tpu_args(p)
+    return p
+
+
+def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    return build_parser().parse_args(argv)

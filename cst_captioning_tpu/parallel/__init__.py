@@ -1,0 +1,31 @@
+"""Parallelism layer: device mesh, sharding rules, data-parallel steps.
+
+The reference's entire distribution story was single-node
+``torch.nn.DataParallel`` with NCCL hidden inside torch (SURVEY.md §2
+"Parallelism strategy inventory").  Here distribution is first-class and
+TPU-native: a ``jax.sharding.Mesh`` over all devices, ``NamedSharding``
+annotations on batch inputs, replicated parameters, and XLA-inserted
+``all-reduce`` over ICI/DCN for gradients — the pjit/GSPMD idiom rather
+than a translation of NCCL calls.
+"""
+
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    host_local_slice,
+    make_mesh,
+    replicated_sharding,
+    shard_batch_arrays,
+)
+from .dp import data_parallel_jit, distributed_init
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "data_parallel_jit",
+    "distributed_init",
+    "host_local_slice",
+    "make_mesh",
+    "replicated_sharding",
+    "shard_batch_arrays",
+]

@@ -1,0 +1,79 @@
+"""Device mesh + sharding rules — the TPU replacement for DataParallel.
+
+Design (SURVEY.md §5 "Distributed communication backend"):
+
+- one logical ``data`` axis spanning every device (all chips of a slice,
+  all slices of a pod); the model is small (~10–50M params) so parameters
+  are replicated and only the batch is sharded.  A ``model`` axis is
+  plumbed (``make_mesh(model_parallel=k)``) but unused by default — the
+  mesh shape is the single point of change if TP is ever wanted;
+- batch arrays are sharded on their leading axis with ``NamedSharding``;
+  everything else (params, opt state, rng) is replicated;
+- gradients need no hand-written psum: with sharded inputs + replicated
+  params, XLA's SPMD partitioner inserts the ICI all-reduce during
+  ``jit`` compilation (the pjit/GSPMD idiom, not a NCCL translation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: int = 1,
+) -> Mesh:
+    """Mesh over ``devices`` (default: all) with axes (data, model).
+
+    ``model_parallel=1`` (default) gives pure data parallelism; the model
+    axis exists so shardings referencing it stay valid if it is widened.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis; rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_arrays(mesh: Mesh, tree):
+    """device_put every array leaf with its leading axis sharded on ``data``.
+
+    Feature lists, label matrices and weight vectors all share the batch
+    leading dim, so one rule covers the whole batch pytree.
+    """
+    sh = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def host_local_slice(global_batch: int, process_index: Optional[int] = None,
+                     process_count: Optional[int] = None) -> slice:
+    """This host's contiguous rows of a globally-assembled batch.
+
+    Multi-host JAX requires each process to provide its addressable shard;
+    loaders build per-host batches of ``global_batch / process_count`` rows
+    (see data.loader's process-strided video sharding) and this maps a
+    host to its row range when a global batch is materialized instead.
+    """
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if global_batch % pc != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {pc} hosts")
+    per = global_batch // pc
+    return slice(pi * per, (pi + 1) * per)

@@ -1,0 +1,28 @@
+"""Training layer: optimizer/state, jitted steps, RL rewards, checkpoints,
+validation, and the stage trainer (XE -> WXE -> CST).
+
+TPU restatement of the reference's ``train.py`` internals (SURVEY.md §3.1,
+§3.2): everything device-side is a pure jitted function compiled once; the
+only host round-trip is the RL stage's string-space CIDEr-D reward,
+deliberately kept *outside* jit (SURVEY.md §7 hard part (a)).
+"""
+
+from .state import create_train_state, make_optimizer
+from .steps import make_rl_grad_step, make_rollout, make_xe_step
+from .rewards import RewardComputer, decode_sequences
+from .checkpoint import CheckpointManager
+from .evaluation import eval_split
+from .trainer import Trainer
+
+__all__ = [
+    "CheckpointManager",
+    "RewardComputer",
+    "Trainer",
+    "create_train_state",
+    "decode_sequences",
+    "eval_split",
+    "make_optimizer",
+    "make_rl_grad_step",
+    "make_rollout",
+    "make_xe_step",
+]

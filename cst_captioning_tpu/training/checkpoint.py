@@ -1,0 +1,125 @@
+"""Checkpoint/resume with orbax — best-metric selection + stage chaining.
+
+Reference semantics to preserve (SURVEY.md §5 "Checkpoint / resume"): save
+model (+ optimizer) state each validation, track the best val score
+(CIDEr by default), keep "best" retrievable so the next stage can
+warm-start from it (WXE loads XE's best, CST loads WXE's best), and store
+an "infos" side record (opts, step, scores) that eval re-reads so test-time
+model hyperparams come from the checkpoint, not the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Orbax-backed manager writing ``step``-numbered checkpoints.
+
+    Layout: ``<dir>/<step>/state`` (orbax standard pytree) plus
+    ``<dir>/infos.json`` holding {"best_step", "best_score", "opts", ...}.
+    The infos file is tiny and host-written — the reference's infos.pkl
+    equivalent, readable without orbax.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 2, keep_best: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._infos_path = os.path.join(self.directory, "infos.json")
+        self.infos: Dict[str, Any] = {"best_step": None, "best_score": None}
+        if os.path.exists(self._infos_path):
+            with open(self._infos_path) as f:
+                self.infos = json.load(f)
+
+        def best_fn(metrics: Dict[str, float]) -> float:
+            return metrics.get("score", float("-inf"))
+
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=best_fn if keep_best else None,
+                best_mode="max",
+                create=True,
+            ),
+        )
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, state, score: Optional[float] = None,
+             extra: Optional[Dict[str, Any]] = None) -> None:
+        """Save state; update best bookkeeping when ``score`` improves."""
+        metrics = {"score": float(score)} if score is not None else None
+        # ``params`` is saved as its own entry so the next stage can
+        # warm-start weights without matching this stage's optimizer
+        # structure (XE -> WXE -> CST chaining, SURVEY.md §5).
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                params=ocp.args.StandardSave(state.params),
+            ),
+            metrics=metrics,
+        )
+        self._mgr.wait_until_finished()
+        if score is not None and (
+            self.infos["best_score"] is None or score > self.infos["best_score"]
+        ):
+            self.infos["best_score"] = float(score)
+            self.infos["best_step"] = int(step)
+        if extra:
+            self.infos.update(extra)
+        self.infos["last_step"] = int(step)
+        with open(self._infos_path, "w") as f:
+            json.dump(self.infos, f, indent=2, default=str)
+
+    # -- restore -----------------------------------------------------------
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    @property
+    def best_step(self) -> Optional[int]:
+        s = self.infos.get("best_step")
+        return int(s) if s is not None else None
+
+    def _resolve_step(self, step: Optional[int], best: bool) -> int:
+        if step is None:
+            step = self.best_step if best else self.latest_step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return step
+
+    def restore(self, abstract_state, step: Optional[int] = None,
+                best: bool = False):
+        """Restore a full train state into the structure of
+        ``abstract_state``.  ``best=True`` loads the best-score step."""
+        step = self._resolve_step(step, best)
+        target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                        abstract_state)
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(target)),
+        )
+        return out["state"]
+
+    def restore_params(self, abstract_params, step: Optional[int] = None,
+                       best: bool = True):
+        """Restore parameters only (stage warm-start path)."""
+        step = self._resolve_step(step, best)
+        target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                        abstract_params)
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(params=ocp.args.StandardRestore(target)),
+        )
+        return out["params"]
+
+    def close(self) -> None:
+        self._mgr.close()

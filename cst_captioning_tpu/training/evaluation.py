@@ -1,0 +1,91 @@
+"""Validation / test evaluation: compiled decode -> predictions -> metrics.
+
+The reference's ``test.py``/``validate`` path (SURVEY.md §3.3): decode every
+video of a split (greedy fast path or beam search), dedupe the loader's
+static-shape padding, build coco-format predictions, run ``language_eval``.
+Both decoders are single compiled XLA programs (one ``lax.scan`` each).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..data.loader import CaptionLoader
+from ..data.vocab import Vocab
+from ..metrics.coco_eval import language_eval
+from ..ops.beam import jit_beam_search
+from ..ops.sampling import jit_sampler
+
+# Flax modules hash by configuration, so this memoizes the *compiled* decode
+# programs across validate() calls — without it every epoch's validation
+# would rebuild the jit closure and recompile the whole decode scan.
+_DECODER_CACHE: dict = {}
+
+
+def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float):
+    key = (model, beam_size, max_len, length_norm)
+    fn = _DECODER_CACHE.get(key)
+    if fn is None:
+        if beam_size > 1:
+            fn = jit_beam_search(model, beam_size, max_len, length_norm)
+        else:
+            fn = jit_sampler(model, max_len, seq_per_img=1, greedy=True)
+        _DECODER_CACHE[key] = fn
+    return fn
+
+
+def decode_split(
+    model,
+    params,
+    loader: CaptionLoader,
+    vocab: Vocab,
+    max_len: int,
+    beam_size: int = 1,
+    length_norm: float = 0.0,
+) -> List[Dict[str, str]]:
+    """One ordered pass over ``loader``'s split -> [{"image_id", "caption"}].
+
+    beam_size == 1 uses the greedy sampler; > 1 the batched beam search.
+    Wrap-padding rows (loader.iter_eval keeps shapes static) are deduped by
+    video id, keeping the first occurrence.
+    """
+    variables = {"params": params}
+    if beam_size > 1:
+        beam = _compiled_decoder(model, beam_size, max_len, length_norm)
+        decode = lambda feats: beam(variables, feats)[0]
+    else:
+        sampler = _compiled_decoder(model, 1, max_len, length_norm)
+        decode = lambda feats: sampler(variables, feats,
+                                       jax.random.PRNGKey(0))[0]
+
+    seen = set()
+    preds: List[Dict[str, str]] = []
+    for batch in loader.iter_eval():
+        tokens = np.asarray(jax.device_get(decode(batch.feats)))
+        for vid, row in zip(batch.video_ids, tokens):
+            if vid in seen:
+                continue
+            seen.add(vid)
+            preds.append({"image_id": vid, "caption": vocab.decode(row)})
+    return preds
+
+
+def eval_split(
+    model,
+    params,
+    loader: CaptionLoader,
+    vocab: Vocab,
+    max_len: int,
+    refs,                                   # {vid: [caption,...]} or cocofmt path
+    beam_size: int = 1,
+    length_norm: float = 0.0,
+    scorers: Optional[Sequence[str]] = None,
+) -> Tuple[List[Dict[str, str]], Dict[str, float]]:
+    """Decode + score one split -> (predictions, metric dict)."""
+    preds = decode_split(model, params, loader, vocab, max_len,
+                         beam_size=beam_size, length_norm=length_norm)
+    scores = language_eval(preds, refs, scorers=scorers)
+    return preds, scores

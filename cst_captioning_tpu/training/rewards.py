@@ -1,0 +1,115 @@
+"""Host-side RL rewards: CIDEr-D advantage with greedy / SCB baselines.
+
+This is the device->host->device boundary of the CST stage (SURVEY.md §3.2
+and §7 hard part (a)): sampled token ids come off the device, are decoded to
+strings, scored with corpus-df CIDEr-D, and return as a per-caption
+advantage array.  Kept outside jit on purpose — deterministic, profilable,
+and overlappable with the next rollout.
+
+Baseline variants (the exact reference SCB formula is unverified —
+SURVEY.md §7 hard part (d) — so all defensible readings are implemented and
+flag-selectable):
+
+- ``greedy``  — SCST: advantage = r(sample) - r(greedy decode of the same
+  video), the north-star formulation [V in BASELINE.json].
+- ``scb-sample`` — self-consensus over the rollout: baseline for sample i of
+  a video is the leave-one-out mean reward of that video's other samples.
+- ``scb-gt`` — consensus of the ground truth: baseline is the mean of the
+  video's top-``scb_captions`` precomputed consensus scores (the
+  ``--train_bcmrscores_pkl`` artifact powering WXE reused as a baseline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.vocab import Vocab
+from ..metrics.ciderd import CiderD
+
+BASELINES = ("greedy", "scb-sample", "scb-gt")
+
+
+def decode_sequences(vocab: Vocab, tokens: np.ndarray) -> List[str]:
+    """(N, L) 0-terminated id rows -> caption strings."""
+    return vocab.decode_batch(np.asarray(tokens))
+
+
+class RewardComputer:
+    """Per-batch CIDEr-D rewards + advantage for the CST/REINFORCE stage."""
+
+    def __init__(
+        self,
+        vocab: Vocab,
+        scorer: CiderD,
+        tokenized_refs: Mapping[str, Sequence[str]],
+        seq_per_img: int,
+        baseline: str = "greedy",
+        consensus_scores: Optional[Mapping[str, np.ndarray]] = None,
+        scb_captions: int = 0,
+    ):
+        if baseline not in BASELINES:
+            raise ValueError(f"baseline {baseline!r} not in {BASELINES}")
+        if baseline == "scb-sample" and seq_per_img < 2:
+            raise ValueError("scb-sample baseline needs seq_per_img >= 2")
+        if baseline == "scb-gt" and consensus_scores is None:
+            raise ValueError("scb-gt baseline needs precomputed consensus scores")
+        self.vocab = vocab
+        self.scorer = scorer
+        self.refs = tokenized_refs
+        self.seq_per_img = seq_per_img
+        self.baseline = baseline
+        self.scb_captions = scb_captions
+        self._scb_gt_cache: Dict[str, float] = {}
+        if consensus_scores is not None:
+            for vid, s in consensus_scores.items():
+                s = np.sort(np.asarray(s, dtype=np.float64))[::-1]
+                k = len(s) if scb_captions <= 0 else min(scb_captions, len(s))
+                self._scb_gt_cache[vid] = float(s[:k].mean()) if k else 0.0
+
+    def _score(self, video_ids: Sequence[str], captions: List[str]) -> np.ndarray:
+        """Score each caption row against its video's reference set."""
+        per_vid = len(captions) // len(video_ids)
+        gts = {}
+        res = []
+        for i, cap in enumerate(captions):
+            vid = video_ids[i // per_vid]
+            key = f"{i}"
+            gts[key] = list(self.refs[vid])
+            res.append({"image_id": key, "caption": [cap]})
+        _, scores = self.scorer.compute_score(gts, res)
+        return scores
+
+    def __call__(
+        self,
+        video_ids: Sequence[str],
+        sampled: np.ndarray,                 # (B*S, L) device->host token ids
+        greedy: Optional[np.ndarray] = None, # (B, L), greedy baseline only
+    ) -> Tuple[np.ndarray, Dict[str, float]]:
+        """-> (advantage (B*S,) float32, stats for logging)."""
+        S = self.seq_per_img
+        sample_caps = decode_sequences(self.vocab, sampled)
+        r_sample = self._score(video_ids, sample_caps)
+
+        if self.baseline == "greedy":
+            if greedy is None:
+                raise ValueError("greedy baseline requires greedy rollouts")
+            r_base = self._score(video_ids, decode_sequences(self.vocab, greedy))
+            baseline = np.repeat(r_base, S)
+        elif self.baseline == "scb-sample":
+            per_vid = r_sample.reshape(-1, S)
+            loo = (per_vid.sum(axis=1, keepdims=True) - per_vid) / (S - 1)
+            baseline = loo.reshape(-1)
+        else:  # scb-gt
+            baseline = np.repeat(
+                [self._scb_gt_cache.get(v, 0.0) for v in video_ids], S
+            )
+
+        advantage = (r_sample - baseline).astype(np.float32)
+        stats = {
+            "reward": float(r_sample.mean()),
+            "baseline": float(np.mean(baseline)),
+            "advantage": float(advantage.mean()),
+        }
+        return advantage, stats

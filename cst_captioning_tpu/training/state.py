@@ -1,0 +1,89 @@
+"""Train state + optimizer factory.
+
+The reference builds a torch optimizer from ``--optim`` with manual lr decay
+every ``--lr_update`` epochs and grad clipping in the loop (SURVEY.md §2
+"Train loop").  Here those are one optax chain: global-norm clip ->
+optimizer-with-schedule; the schedule is baked into the update so the jitted
+step needs no lr argument, and the current lr is recomputable host-side for
+logging.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """Flax TrainState; dropout rng derives from ``step`` via fold_in."""
+
+
+def lr_schedule(
+    base_lr: float,
+    decay_rate: float = 1.0,
+    decay_every_steps: int = 0,
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Staircase exponential decay (reference: lr *= rate every N epochs)."""
+    if decay_rate >= 1.0 or decay_every_steps <= 0:
+        return optax.constant_schedule(base_lr)
+    return optax.exponential_decay(
+        init_value=base_lr,
+        transition_steps=decay_every_steps,
+        decay_rate=decay_rate,
+        staircase=True,
+    )
+
+
+_OPTIMIZERS = {
+    "adam": optax.adam,
+    "adamax": optax.adamax,
+    "adamw": optax.adamw,
+    "rmsprop": optax.rmsprop,
+    "sgd": optax.sgd,
+    "adagrad": optax.adagrad,
+}
+
+
+def make_optimizer(
+    optim: str = "adam",
+    learning_rate: float = 2e-4,
+    grad_clip: float = 0.0,
+    decay_rate: float = 1.0,
+    decay_every_steps: int = 0,
+) -> Tuple[optax.GradientTransformation, Callable]:
+    """-> (optax chain, lr schedule fn) for the reference's ``--optim`` set."""
+    if optim not in _OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {optim!r}; choose {sorted(_OPTIMIZERS)}")
+    sched = lr_schedule(learning_rate, decay_rate, decay_every_steps)
+    parts = []
+    if grad_clip and grad_clip > 0:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts.append(_OPTIMIZERS[optim](learning_rate=sched))
+    return optax.chain(*parts), sched
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    feat_shapes: Sequence[Tuple[int, ...]],
+    seq_length: int,
+    seq_per_img: int,
+    tx: optax.GradientTransformation,
+    batch_size: int = 2,
+) -> TrainState:
+    """Initialize parameters with dummy batch shapes and wrap in TrainState.
+
+    ``feat_shapes`` are per-modality (T, D) — batch dim is added here.
+    """
+    feats = [jnp.zeros((batch_size, t, d), jnp.float32) for t, d in feat_shapes]
+    labels = jnp.zeros((batch_size * seq_per_img, seq_length), jnp.int32)
+    params = model.init(rng, feats, labels, seq_per_img)["params"]
+    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

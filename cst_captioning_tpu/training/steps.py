@@ -1,0 +1,117 @@
+"""Jitted train-step factories: XE/WXE, RL rollout, RL gradient.
+
+Each factory returns a *pure* function suitable for ``jax.jit`` or
+``parallel.data_parallel_jit``.  The CST stage is deliberately two device
+programs with a host gap between them (SURVEY.md §3.2, §7 hard part (a)):
+
+    rollout (device) -> reward/advantage (host, strings) -> grad step (device)
+
+The gradient step recomputes log p(sampled tokens) with the teacher-forced
+``model.__call__`` instead of keeping the rollout graph alive — the
+XLA-native SCST formulation (rollout runs as a fused no-grad scan).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.losses import cross_entropy_loss, reward_loss, sequence_mask, token_logprobs
+from ..ops.sampling import sample_captions
+from .state import TrainState
+
+
+def _grad_norm(grads) -> jnp.ndarray:
+    return optax.global_norm(grads)
+
+
+def make_xe_step(model, seq_per_img: int) -> Callable:
+    """(state, feats, labels, weights, rng) -> (state, metrics).
+
+    ``weights`` = per-caption consensus weights: all-ones reproduces plain
+    XE; consensus softmax weights give the WXE stage.  One compiled step
+    serves both stages (weights are data, not structure).
+    """
+
+    def step(state: TrainState, feats, labels, weights, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params}, feats, labels, seq_per_img,
+                train=True, rngs={"dropout": dropout_rng},
+            )
+            return cross_entropy_loss(logits, labels, weights)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {"loss": loss, "grad_norm": _grad_norm(grads)}
+        return new_state, metrics
+
+    return step
+
+
+def make_rollout(model, max_len: int, seq_per_img: int,
+                 temperature: float = 1.0, greedy_baseline: bool = True) -> Callable:
+    """(params, feats, rng) -> (sampled (B*S, L), greedy (B, L)).
+
+    One device program: multinomial rollout for the policy sample plus the
+    greedy argmax decode used by the SCST baseline.  When the baseline is
+    SCB the greedy decode is dead code XLA never executes — still traced,
+    so one compilation covers both baselines; pass ``greedy_baseline=False``
+    to skip the second scan entirely (smaller program for pure-SCB runs).
+    """
+
+    def rollout(params, feats, rng):
+        variables = {"params": params}
+        sampled, _ = sample_captions(
+            model, variables, feats, rng, max_len,
+            seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+        )
+        if greedy_baseline:
+            greedy_toks, _ = sample_captions(
+                model, variables, feats, rng, max_len,
+                seq_per_img=1, greedy=True,
+            )
+        else:
+            greedy_toks = jnp.zeros(
+                (feats[0].shape[0], max_len), dtype=jnp.int32
+            )
+        return sampled, greedy_toks
+
+    return rollout
+
+
+def make_rl_grad_step(model, seq_per_img: int) -> Callable:
+    """(state, feats, sampled, advantage, rng) -> (state, metrics).
+
+    REINFORCE gradient: recompute log-probs of the sampled sequences under
+    the current params (teacher-forcing the samples), then
+    ``reward_loss`` = -E[advantage * log p].  ``advantage`` (B*S,) comes
+    from the host reward computation and is stop-gradiented inside the loss.
+    """
+
+    def step(state: TrainState, feats, sampled, advantage, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params}, feats, sampled, seq_per_img,
+                train=True, rngs={"dropout": dropout_rng},
+            )
+            logp = token_logprobs(logits, sampled)
+            return reward_loss(logp, sampled, advantage)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": loss,
+            "grad_norm": _grad_norm(grads),
+            "sample_len": sequence_mask(sampled).sum(axis=1).mean(),
+        }
+        return new_state, metrics
+
+    return step

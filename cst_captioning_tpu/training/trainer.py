@@ -1,0 +1,324 @@
+"""Stage trainer: XE / WXE / CST epochs, validation, best-CIDEr checkpoints.
+
+TPU restatement of the reference's ``train.py`` main/train/validate
+(SURVEY.md §3.1–§3.2).  One Trainer instance runs one stage; the 3-stage
+recipe (XE pretrain -> WXE warm-start -> CST fine-tune) chains stages via
+``--start_from`` pointing at the previous stage's checkpoint dir, exactly
+like the reference Makefile does with best checkpoints.
+
+Device/host split per CST iteration:
+  rollout (jit, sharded)  ->  reward (host strings, CIDEr-D corpus-df)
+  ->  grad step (jit, sharded)
+with the next batch's h5 reads + HBM transfer overlapped by the loader's
+prefetch thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..data.dataset import CaptionDataset, SplitPaths
+from ..data.loader import CaptionLoader, prefetch_to_device
+from ..metrics.ciderd import CiderD, build_corpus_df, save_corpus_df
+from ..metrics.consensus import load_consensus, normalize_weights
+from ..metrics.tokenizer import tokenize_corpus
+from ..models.captioner import CaptionModel
+from ..parallel.dp import data_parallel_jit
+from ..parallel.mesh import batch_sharding, make_mesh
+from .checkpoint import CheckpointManager
+from .evaluation import eval_split
+from .rewards import RewardComputer
+from .state import create_train_state, make_optimizer, param_count
+from .steps import make_rl_grad_step, make_rollout, make_xe_step
+
+log = logging.getLogger("cst_captioning_tpu.train")
+
+
+def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
+    """CaptionModel from the opts namespace (reference --model_type etc.)."""
+    import jax.numpy as jnp
+
+    return CaptionModel(
+        vocab_size=vocab_size,
+        embed_size=opt.input_encoding_size,
+        hidden_size=opt.rnn_size,
+        num_layers=opt.num_layers,
+        attn_size=opt.att_size,
+        use_attention=bool(opt.use_attention),
+        dropout_rate=opt.drop_prob,
+        decoder_type=opt.model_type,
+        num_heads=opt.num_heads,
+        num_tx_layers=opt.num_tx_layers,
+        tx_max_len=max(seq_length + 1, opt.max_length + 1),
+        dtype=jnp.bfloat16 if opt.use_bfloat16 else jnp.float32,
+    )
+
+
+def _split_paths(opt, split: str) -> Optional[SplitPaths]:
+    feat = getattr(opt, f"{split}_feat_h5", None)
+    label = getattr(opt, f"{split}_label_h5", None)
+    info = getattr(opt, f"{split}_info_json", None)
+    if not feat or not label or not info:
+        return None
+    return SplitPaths(
+        feat_h5=list(feat),
+        label_h5=label,
+        info_json=info,
+        cocofmt_json=getattr(opt, f"{split}_cocofmt_file", None),
+    )
+
+
+class Trainer:
+    """One training stage (XE, WXE, or CST) over a device mesh."""
+
+    def __init__(self, opt):
+        self.opt = opt
+        self.rng = jax.random.PRNGKey(opt.seed)
+
+        # -- data ----------------------------------------------------------
+        train_paths = _split_paths(opt, "train")
+        if train_paths is None:
+            raise ValueError("train split paths are required")
+        self.train_ds = CaptionDataset(train_paths)
+        val_paths = _split_paths(opt, "val")
+        self.val_ds = CaptionDataset(val_paths) if val_paths else None
+        self.vocab = self.train_ds.vocab
+
+        consensus_weights = None
+        self.consensus_scores = None
+        if getattr(opt, "train_bcmrscores_pkl", None):
+            self.consensus_scores = load_consensus(opt.train_bcmrscores_pkl)
+            if opt.use_consensus_weights:
+                consensus_weights = normalize_weights(
+                    self.consensus_scores, temperature=opt.consensus_temperature
+                )
+                log.info("WXE: loaded consensus weights for %d videos",
+                         len(consensus_weights))
+
+        self.loader = CaptionLoader(
+            self.train_ds,
+            batch_size=opt.batch_size,
+            seq_per_img=opt.seq_per_img,
+            shuffle=True,
+            seed=opt.seed,
+            consensus_weights=consensus_weights,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            # RewardComputer keeps its own tokenized reference corpus, so
+            # per-batch gts assembly would be dead work even in RL.
+            include_gts=False,
+        )
+        self.val_loader = (
+            CaptionLoader(
+                self.val_ds,
+                batch_size=opt.eval_batch_size or opt.batch_size,
+                seq_per_img=1,
+                shuffle=False,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+            )
+            if self.val_ds
+            else None
+        )
+
+        # -- mesh ----------------------------------------------------------
+        devices = jax.devices()
+        n = opt.num_devices or len(devices)
+        if opt.batch_size % n != 0:
+            fit = max(d for d in range(1, n + 1) if opt.batch_size % d == 0)
+            log.warning("batch_size %d not divisible by %d devices; using %d",
+                        opt.batch_size, n, fit)
+            n = fit
+        self.mesh = make_mesh(devices[:n])
+        log.info("mesh: %d device(s) on %s", n, devices[0].platform)
+
+        # -- model / state -------------------------------------------------
+        self.model = build_model(opt, self.vocab.size_with_pad,
+                                 self.train_ds.seq_length)
+        bpe = self.loader.batches_per_epoch
+        tx, self.lr_sched = make_optimizer(
+            optim=opt.optim,
+            learning_rate=opt.learning_rate,
+            grad_clip=opt.grad_clip,
+            decay_rate=opt.learning_rate_decay_rate,
+            decay_every_steps=opt.learning_rate_decay_every * bpe,
+        )
+        feat_shapes = list(zip(self.train_ds.feat_times, self.train_ds.feat_dims))
+        init_rng, self.rng = jax.random.split(self.rng)
+        self.state = create_train_state(
+            self.model, init_rng, feat_shapes, self.train_ds.seq_length,
+            opt.seq_per_img, tx, batch_size=max(2, n),
+        )
+        log.info("model: %s decoder, %.2fM params", opt.model_type,
+                 param_count(self.state.params) / 1e6)
+
+        # Stage chaining: warm-start params from the previous stage's best
+        # checkpoint (fresh optimizer state), like the reference's
+        # --start_from (SURVEY.md §5 checkpoint/resume).
+        if getattr(opt, "start_from", None):
+            prev = CheckpointManager(opt.start_from)
+            params = prev.restore_params(self.state.params, best=True)
+            self.state = self.state.replace(params=params)
+            prev.close()
+            log.info("warm-started params from %s (best step %s)",
+                     opt.start_from, prev.best_step)
+
+        self.ckpt = CheckpointManager(opt.checkpoint_path,
+                                      max_to_keep=opt.max_checkpoints)
+        if self.ckpt.latest_step is not None:
+            self.state = self.ckpt.restore(self.state)
+            log.info("resumed from step %d in %s", int(self.state.step),
+                     opt.checkpoint_path)
+
+        # -- compiled steps ------------------------------------------------
+        self.xe_step = data_parallel_jit(
+            make_xe_step(self.model, opt.seq_per_img), self.mesh,
+            batch_argnums=(1, 2, 3), donate_argnums=(0,),
+        )
+        self.reward_computer = None
+        if opt.use_rl:
+            self._setup_rl()
+
+        self._batch_sharding = batch_sharding(self.mesh)
+        self.history: Dict[str, Any] = {"val": []}
+
+    # -- RL plumbing -------------------------------------------------------
+
+    def _setup_rl(self) -> None:
+        opt = self.opt
+        refs = tokenize_corpus(self.train_ds.references())
+        if getattr(opt, "train_cached_tokens", None):
+            scorer = CiderD(df_mode="corpus", df_path=opt.train_cached_tokens)
+        else:
+            log.info("no --train_cached_tokens; building corpus df in-process")
+            df, ndocs = build_corpus_df(refs)
+            scorer = CiderD(df_mode="corpus", df=df, ref_len=float(ndocs))
+        self.reward_computer = RewardComputer(
+            self.vocab, scorer, refs,
+            seq_per_img=opt.seq_per_img,
+            baseline=opt.rl_baseline,
+            consensus_scores=self.consensus_scores,
+            scb_captions=opt.scb_captions,
+        )
+        self.rollout = data_parallel_jit(
+            make_rollout(self.model, opt.max_length, opt.seq_per_img,
+                         temperature=opt.temperature,
+                         greedy_baseline=opt.rl_baseline == "greedy"),
+            self.mesh, batch_argnums=(1,), donate_argnums=(),
+        )
+        self.rl_step = data_parallel_jit(
+            make_rl_grad_step(self.model, opt.seq_per_img), self.mesh,
+            batch_argnums=(1, 2, 3), donate_argnums=(0,),
+        )
+
+    # -- iteration bodies --------------------------------------------------
+
+    def _xe_iteration(self, batch) -> Dict[str, float]:
+        self.state, metrics = self.xe_step(
+            self.state, batch.feats, batch.labels, batch.weights, self.rng
+        )
+        return metrics
+
+    def _rl_iteration(self, batch) -> Dict[str, float]:
+        step = int(self.state.step)
+        roll_rng = jax.random.fold_in(self.rng, step)
+        sampled, greedy = self.rollout(self.state.params, batch.feats, roll_rng)
+        sampled = np.asarray(jax.device_get(sampled))
+        greedy = np.asarray(jax.device_get(greedy))
+        advantage, stats = self.reward_computer(batch.video_ids, sampled, greedy)
+        self.state, metrics = self.rl_step(
+            self.state, batch.feats, sampled, advantage, self.rng
+        )
+        metrics = dict(metrics)
+        metrics.update(stats)
+        return metrics
+
+    # -- main loop ---------------------------------------------------------
+
+    def validate(self) -> Optional[Dict[str, float]]:
+        if self.val_loader is None:
+            return None
+        refs = self.val_ds.references()
+        scorers = ("CIDEr",) if self.opt.fast_val else None
+        _, scores = eval_split(
+            self.model, self.state.params, self.val_loader, self.vocab,
+            self.opt.max_length, refs,
+            beam_size=self.opt.val_beam_size,
+            length_norm=self.opt.length_norm,
+            scorers=scorers,
+        )
+        return scores
+
+    def train(self) -> Dict[str, Any]:
+        opt = self.opt
+        bpe = self.loader.batches_per_epoch
+        it = iter(prefetch_to_device(
+            iter(self.loader), size=2,
+            device_put=lambda x: jax.device_put(x, self._batch_sharding),
+        ))
+        start_step = int(self.state.step)
+        total_steps = opt.max_epochs * bpe
+        best = self.ckpt.infos.get("best_score")
+        best = float("-inf") if best is None else float(best)
+        patience = 0
+        t0 = time.time()
+        captions_done = 0
+
+        for step in range(start_step, total_steps):
+            batch = next(it)
+            metrics = (self._rl_iteration(batch) if opt.use_rl
+                       else self._xe_iteration(batch))
+            captions_done += opt.batch_size * opt.seq_per_img
+
+            if (step + 1) % opt.log_every == 0:
+                m = {k: float(v) for k, v in metrics.items()}
+                dt = time.time() - t0
+                log.info(
+                    "step %d/%d epoch %.2f %s lr %.2e | %.0f captions/s",
+                    step + 1, total_steps, (step + 1) / bpe,
+                    " ".join(f"{k} {v:.4f}" for k, v in m.items()),
+                    float(self.lr_sched(step)),
+                    captions_done / max(dt, 1e-9),
+                )
+                t0, captions_done = time.time(), 0
+
+            if (step + 1) % bpe == 0:  # epoch boundary
+                scores = self.validate()
+                if scores is not None:
+                    metric = scores.get(opt.eval_metric, 0.0)
+                    self.history["val"].append(
+                        {"step": step + 1, **scores}
+                    )
+                    log.info("val @ step %d: %s", step + 1,
+                             {k: round(v, 4) for k, v in scores.items()})
+                    self.ckpt.save(step + 1, self.state, score=metric,
+                                   extra={"opt": vars(opt),
+                                          "val_scores": scores})
+                    if metric > best:
+                        best, patience = metric, 0
+                    else:
+                        patience += 1
+                        if opt.max_patience and patience >= opt.max_patience:
+                            log.info("early stop: no %s improvement in %d epochs",
+                                     opt.eval_metric, patience)
+                            break
+                else:
+                    self.ckpt.save(step + 1, self.state)
+
+        return {
+            "best_score": None if best == float("-inf") else best,
+            "best_step": self.ckpt.best_step,
+            "last_step": int(self.state.step),
+            "history": self.history,
+        }
+
+    def close(self) -> None:
+        self.ckpt.close()
+        self.train_ds.close()
+        if self.val_ds:
+            self.val_ds.close()

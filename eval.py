@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Eval CLI — the reference ``test.py`` surface (SURVEY.md §3.3).
+
+Loads a stage's BEST checkpoint (model hyperparams come from the
+checkpoint's saved opts, not the CLI — reference semantics), decodes the
+test split with the compiled beam search (``--beam_size``, 1 = greedy),
+writes coco-format predictions + scores JSON, prints the metric table.
+
+  python eval.py --checkpoint_path <dir> \\
+      --test_feat_h5 ... --test_label_h5 ... --test_info_json ... \\
+      --test_cocofmt_file ... --beam_size 5 --result_file scores.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+import jax
+
+from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+from cst_captioning_tpu.data.loader import CaptionLoader
+from cst_captioning_tpu.opts import parse_opts
+from cst_captioning_tpu.training.checkpoint import CheckpointManager
+from cst_captioning_tpu.training.evaluation import eval_split
+from cst_captioning_tpu.training.state import create_train_state, make_optimizer
+from cst_captioning_tpu.training.trainer import build_model
+
+log = logging.getLogger("cst_captioning_tpu.eval")
+
+
+def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
+                        cli_opt: argparse.Namespace):
+    """Rebuild the model from checkpoint infos and restore BEST params."""
+    ckpt = CheckpointManager(checkpoint_path)
+    saved = ckpt.infos.get("opt")
+    if saved:
+        opt = argparse.Namespace(**{**vars(cli_opt), **{
+            k: saved[k] for k in (
+                "model_type", "rnn_size", "input_encoding_size", "num_layers",
+                "att_size", "use_attention", "drop_prob", "num_heads",
+                "num_tx_layers", "use_bfloat16", "max_length",
+            ) if k in saved
+        }})
+    else:
+        log.warning("checkpoint has no saved opts; using CLI model flags")
+        opt = cli_opt
+    model = build_model(opt, dataset.vocab.size_with_pad, dataset.seq_length)
+    tx, _ = make_optimizer()
+    feat_shapes = list(zip(dataset.feat_times, dataset.feat_dims))
+    state = create_train_state(model, jax.random.PRNGKey(0), feat_shapes,
+                               dataset.seq_length, 1, tx)
+    params = ckpt.restore_params(state.params, best=True)
+    ckpt.close()
+    return model, params, opt
+
+
+def main(argv=None) -> int:
+    opt = parse_opts(argv)
+    logging.basicConfig(
+        level=getattr(logging, opt.loglevel.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    paths = SplitPaths(
+        feat_h5=list(opt.test_feat_h5),
+        label_h5=opt.test_label_h5,
+        info_json=opt.test_info_json,
+        cocofmt_json=opt.test_cocofmt_file,
+    )
+    with CaptionDataset(paths) as ds:
+        model, params, opt = load_model_for_eval(opt.checkpoint_path, ds, opt)
+        loader = CaptionLoader(ds, batch_size=opt.eval_batch_size or opt.batch_size,
+                               seq_per_img=1, shuffle=False)
+        preds, scores = eval_split(
+            model, params, loader, ds.vocab, opt.max_length,
+            ds.references(),
+            beam_size=opt.beam_size, length_norm=opt.length_norm,
+        )
+    log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
+    if opt.result_file:
+        with open(opt.result_file, "w") as f:
+            json.dump({"scores": scores, "predictions": preds}, f, indent=2)
+        log.info("wrote %s", opt.result_file)
+    print(json.dumps(scores))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Score an arbitrary predictions JSON against references — no model needed.
+
+The reference's ``standalone_eval.py`` equivalent (SURVEY.md §2): accepts
+either a bare list of {"image_id", "caption"} or the {"predictions": [...]}
+wrapper eval.py writes, plus a coco-format annotations file.
+
+  python standalone_eval.py predictions.json refs_cocofmt.json [-o scores.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from cst_captioning_tpu.metrics.coco_eval import language_eval
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("predictions")
+    p.add_argument("references", help="coco-format annotations JSON")
+    p.add_argument("-o", "--output", default=None)
+    args = p.parse_args(argv)
+
+    with open(args.predictions) as f:
+        preds = json.load(f)
+    if isinstance(preds, dict):
+        preds = preds["predictions"]
+    scores = language_eval(preds, args.references)
+    print(json.dumps(scores, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(scores, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,7 +118,7 @@ class TestLoader:
         np.testing.assert_array_equal(a.labels, b.labels)
 
     def test_consensus_weights_applied(self, ds, synth):
-        weights = load_consensus(synth["consensus_pkl"])
+        weights = load_consensus(synth["wxe_weights_pkl"])
         loader = CaptionLoader(ds, batch_size=4, seq_per_img=5, shuffle=False,
                                consensus_weights=weights)
         b = loader.next_batch()

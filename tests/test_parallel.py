@@ -1,0 +1,130 @@
+"""Parallel layer: mesh construction, batch sharding, DP == single-device.
+
+Runs on the 8-device virtual CPU mesh (conftest.py) — SURVEY.md §4
+"Distributed without a cluster".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cst_captioning_tpu.parallel import (
+    batch_sharding,
+    data_parallel_jit,
+    host_local_slice,
+    make_mesh,
+    replicated_sharding,
+    shard_batch_arrays,
+)
+
+
+class TestMesh:
+    def test_make_mesh_all_devices(self):
+        mesh = make_mesh()
+        assert mesh.devices.size == jax.device_count()
+        assert mesh.axis_names == ("data", "model")
+
+    def test_make_mesh_subset(self):
+        mesh = make_mesh(jax.devices()[:4])
+        assert mesh.devices.size == 4
+
+    def test_model_parallel_axis(self):
+        mesh = make_mesh(jax.devices()[:8], model_parallel=2)
+        assert mesh.shape["data"] == 4
+        assert mesh.shape["model"] == 2
+
+    def test_indivisible_model_parallel_raises(self):
+        with pytest.raises(ValueError):
+            make_mesh(jax.devices()[:6], model_parallel=4)
+
+    def test_shard_batch_arrays(self):
+        mesh = make_mesh(jax.devices()[:8])
+        batch = {
+            "feats": [np.ones((16, 4, 8), np.float32)],
+            "labels": np.zeros((16 * 2, 5), np.int32),
+        }
+        out = shard_batch_arrays(mesh, batch)
+        assert out["feats"][0].sharding == batch_sharding(mesh)
+        # 16 rows over 8 devices -> 2 rows per shard
+        shard_shapes = {s.data.shape for s in out["feats"][0].addressable_shards}
+        assert shard_shapes == {(2, 4, 8)}
+        assert out["labels"].sharding.spec == batch_sharding(mesh).spec
+
+    def test_host_local_slice(self):
+        assert host_local_slice(32, 1, 4) == slice(8, 16)
+        with pytest.raises(ValueError):
+            host_local_slice(30, 0, 4)
+
+
+class TestDataParallelJit:
+    """A toy regression step must produce bitwise-identical math whether run
+    on 1 device or sharded over 8 — the grad all-reduce is XLA's job."""
+
+    def _make_step(self):
+        def step(state, batch, rng):
+            params, opt_state = state
+            x, y = batch
+
+            def loss_fn(p):
+                pred = x @ p["w"] + p["b"]
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        return step
+
+    def _init(self):
+        rng = np.random.default_rng(0)
+        params = {
+            "w": jnp.asarray(rng.standard_normal((8, 1)), jnp.float32),
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+        self.tx = optax.adam(1e-2)
+        return params, self.tx.init(params)
+
+    def _run(self, n_devices, steps=5):
+        mesh = make_mesh(jax.devices()[:n_devices])
+        state = jax.device_put(self._init(), replicated_sharding(mesh))
+        step = data_parallel_jit(self._make_step(), mesh,
+                                 batch_argnums=(1,), donate_argnums=(0,))
+        rng = np.random.default_rng(42)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 1)), jnp.float32)
+        batch = shard_batch_arrays(mesh, (x, y))
+        losses = []
+        for _ in range(steps):
+            state, loss = step(state, batch, jax.random.PRNGKey(0))
+            losses.append(float(loss))
+        return losses, jax.device_get(state[0])
+
+    def test_dp_matches_single_device(self):
+        losses1, params1 = self._run(1)
+        losses8, params8 = self._run(8)
+        np.testing.assert_allclose(losses1, losses8, rtol=1e-5)
+        for k in params1:
+            np.testing.assert_allclose(params1[k], params8[k], rtol=1e-5)
+
+    def test_loss_decreases(self):
+        losses, _ = self._run(8, steps=20)
+        assert losses[-1] < losses[0]
+
+    def test_jit_cache_reused(self):
+        mesh = make_mesh(jax.devices()[:2])
+        calls = []
+
+        def step(state, batch, rng):
+            calls.append(1)  # traced once per structure, not per call
+            return state, batch.sum()
+
+        fn = data_parallel_jit(step, mesh, batch_argnums=(1,),
+                               donate_argnums=())
+        x = shard_batch_arrays(mesh, jnp.ones((4, 2)))
+        s = jax.device_put(jnp.zeros(()), replicated_sharding(mesh))
+        for _ in range(3):
+            s, _ = fn(s, x, jax.random.PRNGKey(0))
+        assert len(calls) == 1

@@ -1,0 +1,238 @@
+"""Training layer units: optimizer, XE/RL steps, rewards, checkpointing.
+
+SURVEY.md §4: XE overfit-to-zero, RL advantage-sign sanity, checkpoint
+save/restore exactness.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.losses import token_logprobs
+from cst_captioning_tpu.training.checkpoint import CheckpointManager
+from cst_captioning_tpu.training.rewards import RewardComputer, decode_sequences
+from cst_captioning_tpu.training.state import (
+    create_train_state,
+    make_optimizer,
+    param_count,
+)
+from cst_captioning_tpu.training.steps import (
+    make_rl_grad_step,
+    make_rollout,
+    make_xe_step,
+)
+
+VOCAB_WORDS = {1: "a", 2: "man", 3: "is", 4: "cooking", 5: "dog", 6: "runs"}
+B, S, L = 2, 2, 6
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return Vocab(VOCAB_WORDS)
+
+
+def tiny_model(vocab):
+    return CaptionModel(vocab_size=vocab.size_with_pad, embed_size=16,
+                        hidden_size=16, attn_size=16, dropout_rate=0.0)
+
+
+@pytest.fixture(scope="module")
+def setup(vocab):
+    model = tiny_model(vocab)
+    tx, _ = make_optimizer(learning_rate=3e-2, grad_clip=5.0)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), [(3, 8)], L, S, tx, batch_size=B
+    )
+    feats = [jax.random.normal(jax.random.PRNGKey(1), (B, 3, 8))]
+    labels = jnp.array([[1, 2, 3, 4, 0, 0]] * S + [[5, 6, 0, 0, 0, 0]] * S,
+                       dtype=jnp.int32)
+    return model, state, feats, labels
+
+
+class TestOptimizer:
+    def test_unknown_optim_raises(self):
+        with pytest.raises(ValueError):
+            make_optimizer(optim="lbfgs")
+
+    def test_lr_decay_staircase(self):
+        _, sched = make_optimizer(learning_rate=1.0, decay_rate=0.5,
+                                  decay_every_steps=10)
+        assert float(sched(0)) == pytest.approx(1.0)
+        assert float(sched(9)) == pytest.approx(1.0)
+        assert float(sched(10)) == pytest.approx(0.5)
+        assert float(sched(25)) == pytest.approx(0.25)
+
+    def test_no_decay_by_default(self):
+        _, sched = make_optimizer(learning_rate=0.1)
+        assert float(sched(10_000)) == pytest.approx(0.1)
+
+    def test_param_count_positive(self, setup):
+        _, state, _, _ = setup
+        assert param_count(state.params) > 1000
+
+
+class TestXEStep:
+    def test_overfit_to_near_zero(self, setup):
+        model, state, feats, labels = setup
+        step = jax.jit(make_xe_step(model, S))
+        weights = jnp.ones((B * S,))
+        rng = jax.random.PRNGKey(2)
+        first = None
+        for _ in range(150):
+            state, metrics = step(state, feats, labels, weights, rng)
+            if first is None:
+                first = float(metrics["loss"])
+        assert first > 0.5
+        assert float(metrics["loss"]) < 0.15
+
+    def test_wxe_weighting_changes_grads(self, setup):
+        model, state, feats, labels = setup
+        step = jax.jit(make_xe_step(model, S))
+        rng = jax.random.PRNGKey(2)
+        _, m_flat = step(state, feats, labels, jnp.ones((B * S,)), rng)
+        # rows 0/1 and 2/3 are duplicate captions, so weights must shift
+        # mass BETWEEN videos (not within) to change the total
+        w = jnp.array([4.0, 0.0, 0.0, 0.0])
+        _, m_wxe = step(state, feats, labels, w, rng)
+        assert float(m_flat["loss"]) != pytest.approx(float(m_wxe["loss"]))
+
+
+class TestRewards:
+    def _computer(self, vocab, baseline="greedy", **kw):
+        refs = {"v0": ["a man is cooking"], "v1": ["a dog runs"]}
+        df, n = build_corpus_df(refs)
+        scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+        return RewardComputer(vocab, scorer, refs, seq_per_img=S,
+                              baseline=baseline, **kw)
+
+    def test_decode_sequences(self, vocab):
+        toks = np.array([[1, 2, 0, 0], [5, 6, 0, 0]])
+        assert decode_sequences(vocab, toks) == ["a man", "dog runs"]
+
+    def test_greedy_baseline_advantage_sign(self, vocab):
+        rc = self._computer(vocab)
+        # v0 samples: exact match + garbage; greedy: garbage for v0, exact for v1
+        sampled = np.array([
+            [1, 2, 3, 4, 0, 0],   # v0 sample 0: perfect
+            [5, 5, 5, 5, 0, 0],   # v0 sample 1: garbage
+            [1, 5, 6, 0, 0, 0],   # v1 sample 0: perfect
+            [2, 2, 2, 2, 0, 0],   # v1 sample 1: garbage
+        ])
+        greedy = np.array([
+            [6, 6, 6, 0, 0, 0],   # v0 greedy: garbage -> sample 0 adv > 0
+            [1, 5, 6, 0, 0, 0],   # v1 greedy: perfect -> sample 1 adv < 0
+        ])
+        adv, stats = rc(["v0", "v1"], sampled, greedy)
+        assert adv.shape == (4,)
+        assert adv[0] > 0          # better than its baseline
+        assert adv[1] <= 0         # garbage vs garbage baseline
+        assert adv[2] == pytest.approx(0.0, abs=1e-6)  # perfect vs perfect
+        assert adv[3] < 0          # garbage vs perfect baseline
+        assert stats["reward"] > 0
+
+    def test_scb_sample_baseline_zero_mean_per_video(self, vocab):
+        rc = self._computer(vocab, baseline="scb-sample")
+        sampled = np.array([
+            [1, 2, 3, 4, 0, 0], [5, 5, 5, 5, 0, 0],
+            [1, 5, 6, 0, 0, 0], [2, 2, 2, 2, 0, 0],
+        ])
+        adv, _ = rc(["v0", "v1"], sampled)
+        # with S=2 leave-one-out, advantages are antisymmetric per video
+        assert adv[0] == pytest.approx(-adv[1], abs=1e-5)
+        assert adv[2] == pytest.approx(-adv[3], abs=1e-5)
+        assert adv[0] > 0  # perfect sample beats its garbage sibling
+
+    def test_scb_gt_baseline(self, vocab):
+        cons = {"v0": np.array([2.0, 4.0]), "v1": np.array([1.0])}
+        rc = self._computer(vocab, baseline="scb-gt", consensus_scores=cons,
+                            scb_captions=1)
+        sampled = np.zeros((4, 6), dtype=np.int64)
+        adv, stats = rc(["v0", "v1"], sampled)
+        # empty samples score 0; baseline = top-1 consensus
+        assert adv[0] == pytest.approx(-4.0)
+        assert adv[2] == pytest.approx(-1.0)
+
+    def test_bad_config_raises(self, vocab):
+        with pytest.raises(ValueError):
+            self._computer(vocab, baseline="scb-gt")  # no consensus scores
+        with pytest.raises(ValueError):
+            self._computer(vocab, baseline="nope")
+
+
+class TestRLStep:
+    def test_positive_advantage_raises_sample_logprob(self, setup):
+        model, state, feats, _ = setup
+        rollout = jax.jit(make_rollout(model, L, S))
+        rl_step = jax.jit(make_rl_grad_step(model, S))
+        sampled, greedy = rollout(state.params, feats, jax.random.PRNGKey(3))
+        assert sampled.shape == (B * S, L)
+        assert greedy.shape == (B, L)
+        adv = jnp.ones((B * S,))  # uniformly reward the sampled captions
+
+        def mean_logp(params):
+            logits = model.apply({"params": params}, feats, sampled, S)
+            return float(token_logprobs(logits, sampled).mean())
+
+        before = mean_logp(state.params)
+        for _ in range(5):
+            state, metrics = rl_step(state, feats, sampled, adv,
+                                     jax.random.PRNGKey(4))
+        after = mean_logp(state.params)
+        assert after > before
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_zero_advantage_no_update(self, setup):
+        model, state, feats, _ = setup
+        rollout = jax.jit(make_rollout(model, L, S))
+        rl_step = jax.jit(make_rl_grad_step(model, S))
+        sampled, _ = rollout(state.params, feats, jax.random.PRNGKey(3))
+        new_state, metrics = rl_step(state, feats, sampled,
+                                     jnp.zeros((B * S,)), jax.random.PRNGKey(4))
+        assert float(metrics["loss"]) == 0.0
+        # adam with zero grads produces zero updates
+        np.testing.assert_allclose(
+            np.asarray(jax.tree_util.tree_leaves(new_state.params)[0]),
+            np.asarray(jax.tree_util.tree_leaves(state.params)[0]),
+        )
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, setup, tmp_path):
+        _, state, _, _ = setup
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, state, score=0.5)
+        restored = mgr.restore(state)
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()
+
+    def test_best_tracking_and_reload(self, setup, tmp_path):
+        _, state, _, _ = setup
+        d = str(tmp_path / "ckpt2")
+        mgr = CheckpointManager(d)
+        mgr.save(1, state, score=0.3)
+        mgr.save(2, state.replace(step=jnp.asarray(2)), score=0.7)
+        mgr.save(3, state.replace(step=jnp.asarray(3)), score=0.4)
+        assert mgr.best_step == 2
+        assert mgr.latest_step == 3
+        mgr.close()
+        # a fresh manager on the same dir sees the same bookkeeping
+        mgr2 = CheckpointManager(d)
+        assert mgr2.best_step == 2
+        best = mgr2.restore(state, best=True)
+        assert int(best.step) == 2
+        mgr2.close()
+
+    def test_restore_empty_raises(self, setup, tmp_path):
+        _, state, _, _ = setup
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(state)
+        mgr.close()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Train CLI — reference-compatible entry point (SURVEY.md §3.1/§3.2).
+
+Runs one stage per invocation, like the reference ``train.py``:
+
+  XE pretrain:   python train.py --train_feat_h5 ... --train_label_h5 ...
+  WXE:           ... --use_consensus_weights 1 --train_bcmrscores_pkl ...
+                 --start_from <xe checkpoint dir>
+  CST/REINFORCE: ... --use_rl 1 --rl_baseline greedy|scb-sample|scb-gt
+                 --start_from <wxe checkpoint dir>
+
+See Makefile for the full three-stage recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+from cst_captioning_tpu.opts import parse_opts
+from cst_captioning_tpu.parallel.dp import distributed_init
+from cst_captioning_tpu.training.trainer import Trainer
+
+
+def main(argv=None) -> int:
+    opt = parse_opts(argv)
+    logging.basicConfig(
+        level=getattr(logging, opt.loglevel.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    distributed_init(opt.coordinator_address,
+                     opt.num_processes or None, opt.process_id)
+    trainer = Trainer(opt)
+    try:
+        result = trainer.train()
+    finally:
+        trainer.close()
+    summary = {
+        "best_score": result["best_score"],
+        "best_step": result["best_step"],
+        "last_step": result["last_step"],
+        "eval_metric": opt.eval_metric,
+        "checkpoint_path": opt.checkpoint_path,
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
