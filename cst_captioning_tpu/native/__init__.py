@@ -1,0 +1,188 @@
+"""Native (C++) reward scorer binding — ctypes, compiled on first use.
+
+The RL stage calls CIDEr-D once per training step on every sampled +
+baseline caption (SURVEY.md §3.2 hot loop).  ``NativeCiderD`` keeps that
+work in C++ and consumes token-id arrays straight from the device rollout —
+no id->string->split round trip.  Scores are parity-tested against
+``metrics.ciderd.CiderD`` (tests/test_native_ciderd.py).
+
+Build model: a single translation unit compiled with g++ into a shared
+library next to the source, rebuilt automatically when the .cpp is newer
+(no pybind11 — plain ``extern "C"`` + ctypes, per the environment's
+toolchain constraints).  Callers that must run without a toolchain catch
+``NativeUnavailable`` and fall back to the pure-Python scorer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "ciderd.cpp")
+_LIB = os.path.join(_DIR, "libciderd.so")
+_LOCK = threading.Lock()
+_loaded: Optional[ctypes.CDLL] = None
+
+
+class NativeUnavailable(RuntimeError):
+    """Raised when the shared library cannot be built/loaded."""
+
+
+def _build() -> None:
+    # No -march=native: the .so is cached on disk and a host-specific ISA
+    # would SIGILL (uncatchable) if the cache ever moved between machines.
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise NativeUnavailable("g++ not available") from e
+    except subprocess.CalledProcessError as e:
+        raise NativeUnavailable(f"native build failed:\n{e.stderr}") from e
+
+
+def load_library() -> ctypes.CDLL:
+    """Compile (if stale) and load libciderd.so; cached per process."""
+    global _loaded
+    with _LOCK:
+        if _loaded is not None:
+            return _loaded
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            raise NativeUnavailable(f"cannot load {_LIB}: {e}") from e
+        lib.ciderd_new.restype = ctypes.c_void_p
+        lib.ciderd_new.argtypes = [ctypes.c_int, ctypes.c_double]
+        lib.ciderd_free.argtypes = [ctypes.c_void_p]
+        lib.ciderd_add_video.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.ciderd_finalize.argtypes = [ctypes.c_void_p]
+        lib.ciderd_num_videos.restype = ctypes.c_int
+        lib.ciderd_num_videos.argtypes = [ctypes.c_void_p]
+        lib.ciderd_score.restype = ctypes.c_int
+        lib.ciderd_score.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        _loaded = lib
+        return lib
+
+
+def _as_i32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeCiderD:
+    """Corpus-df CIDEr-D over token ids, references fixed at construction.
+
+    Args:
+      tokenized_refs: {video_id: [pre-tokenized caption string, ...]} — the
+        training references (the corpus that defines document frequencies,
+        like the reference's ``--train_cached_tokens`` pickle).
+      word_to_ix: seed word->id mapping (the model vocab).  Reference words
+        outside it get fresh ids here — they can never match a hypothesis
+        (hyp ids come from the model vocab) but must still contribute to
+        reference norms and df, exactly as in the string scorer.
+    """
+
+    def __init__(
+        self,
+        tokenized_refs: Mapping[str, Sequence[str]],
+        word_to_ix: Optional[Mapping[str, int]] = None,
+        n: int = 4,
+        sigma: float = 6.0,
+    ):
+        self._lib = load_library()
+        self.n = n
+        self.sigma = sigma
+        self._w2i: Dict[str, int] = dict(word_to_ix or {})
+        self._next_id = max(self._w2i.values(), default=0) + 1
+        self._video_ix: Dict[str, int] = {}
+        self._handle = self._lib.ciderd_new(n, sigma)
+        try:
+            for vid, caps in tokenized_refs.items():
+                rows = [self._encode(c) for c in caps]
+                lens = np.asarray([len(r) for r in rows], dtype=np.int32)
+                flat = (np.concatenate(rows).astype(np.int32)
+                        if rows else np.zeros(0, np.int32))
+                self._lib.ciderd_add_video(
+                    self._handle, _as_i32_ptr(flat), _as_i32_ptr(lens),
+                    len(rows),
+                )
+                self._video_ix[vid] = len(self._video_ix)
+            self._lib.ciderd_finalize(self._handle)
+        except Exception:
+            self.close()
+            raise
+
+    def _encode(self, caption: str) -> np.ndarray:
+        ids = []
+        for w in caption.split():
+            ix = self._w2i.get(w)
+            if ix is None:
+                ix = self._next_id
+                self._w2i[w] = ix
+                self._next_id += 1
+            ids.append(ix)
+        return np.asarray(ids, dtype=np.int32)
+
+    # -- scoring -----------------------------------------------------------
+
+    def score_ids(self, video_ids: Sequence[str],
+                  hyps: np.ndarray) -> np.ndarray:
+        """Score 0-terminated id rows (N, L); row i belongs to
+        ``video_ids[i * len(video_ids) // N]`` — i.e. N must be a multiple
+        of len(video_ids), rows grouped per video (the rollout layout)."""
+        hyps = np.ascontiguousarray(hyps, dtype=np.int32)
+        n_hyps, max_len = hyps.shape
+        per_vid = n_hyps // len(video_ids)
+        ix = np.asarray(
+            [self._video_ix[video_ids[i // per_vid]] for i in range(n_hyps)],
+            dtype=np.int32,
+        )
+        out = np.zeros(n_hyps, dtype=np.float64)
+        rc = self._lib.ciderd_score(
+            self._handle, _as_i32_ptr(ix), _as_i32_ptr(hyps),
+            max_len, n_hyps,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        if rc != 0:
+            raise RuntimeError(f"ciderd_score failed with code {rc}")
+        return out
+
+    def score_strings(self, video_ids: Sequence[str],
+                      captions: Sequence[str]) -> np.ndarray:
+        """Tokenized caption strings -> scores (parity/test path)."""
+        rows = [self._encode(c) for c in captions]
+        max_len = max((len(r) for r in rows), default=0) + 1
+        mat = np.zeros((len(rows), max_len), dtype=np.int32)
+        for i, r in enumerate(rows):
+            mat[i, : len(r)] = r
+        return self.score_ids(video_ids, mat)
+
+    @property
+    def num_videos(self) -> int:
+        return int(self._lib.ciderd_num_videos(self._handle))
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.ciderd_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -89,6 +89,9 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                         "0 = all")
     g.add_argument("--temperature", type=float, default=1.0,
                    help="multinomial sampling temperature")
+    g.add_argument("--native_cider", type=int, default=1,
+                   help="1 = C++ CIDEr-D reward scorer (token-id fast path);"
+                        " 0 = pure-Python scorer honoring --train_cached_tokens")
     g.add_argument("--use_consensus_weights", type=int, default=0,
                    help="1 = WXE: weight each caption's XE loss by its "
                         "consensus score (needs --train_bcmrscores_pkl)")

@@ -91,7 +91,11 @@ class CheckpointManager:
 
     def _resolve_step(self, step: Optional[int], best: bool) -> int:
         if step is None:
-            step = self.best_step if best else self.latest_step
+            # A stage trained without a val split never records scores, so
+            # best_step stays None — fall back to the latest checkpoint
+            # rather than failing stage chaining / eval.
+            step = (self.best_step if best and self.best_step is not None
+                    else self.latest_step)
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         return step

@@ -57,6 +57,9 @@ class RewardComputer:
             raise ValueError("scb-gt baseline needs precomputed consensus scores")
         self.vocab = vocab
         self.scorer = scorer
+        # Native scorer (cst_captioning_tpu.native.NativeCiderD) consumes
+        # token-id arrays directly — no id->string->split round trip.
+        self._native = hasattr(scorer, "score_ids")
         self.refs = tokenized_refs
         self.seq_per_img = seq_per_img
         self.baseline = baseline
@@ -67,6 +70,14 @@ class RewardComputer:
                 s = np.sort(np.asarray(s, dtype=np.float64))[::-1]
                 k = len(s) if scb_captions <= 0 else min(scb_captions, len(s))
                 self._scb_gt_cache[vid] = float(s[:k].mean()) if k else 0.0
+
+    def _reward(self, video_ids: Sequence[str],
+                token_rows: np.ndarray) -> np.ndarray:
+        """(N, L) 0-terminated id rows -> per-row CIDEr-D, scorer-agnostic."""
+        if self._native:
+            return self.scorer.score_ids(video_ids, np.asarray(token_rows))
+        return self._score(video_ids,
+                           decode_sequences(self.vocab, token_rows))
 
     def _score(self, video_ids: Sequence[str], captions: List[str]) -> np.ndarray:
         """Score each caption row against its video's reference set."""
@@ -89,14 +100,12 @@ class RewardComputer:
     ) -> Tuple[np.ndarray, Dict[str, float]]:
         """-> (advantage (B*S,) float32, stats for logging)."""
         S = self.seq_per_img
-        sample_caps = decode_sequences(self.vocab, sampled)
-        r_sample = self._score(video_ids, sample_caps)
+        r_sample = self._reward(video_ids, sampled)
 
         if self.baseline == "greedy":
             if greedy is None:
                 raise ValueError("greedy baseline requires greedy rollouts")
-            r_base = self._score(video_ids, decode_sequences(self.vocab, greedy))
-            baseline = np.repeat(r_base, S)
+            baseline = np.repeat(self._reward(video_ids, greedy), S)
         elif self.baseline == "scb-sample":
             per_vid = r_sample.reshape(-1, S)
             loo = (per_vid.sum(axis=1, keepdims=True) - per_vid) / (S - 1)

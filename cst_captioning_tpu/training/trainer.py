@@ -192,12 +192,34 @@ class Trainer:
     def _setup_rl(self) -> None:
         opt = self.opt
         refs = tokenize_corpus(self.train_ds.references())
-        if getattr(opt, "train_cached_tokens", None):
-            scorer = CiderD(df_mode="corpus", df_path=opt.train_cached_tokens)
-        else:
-            log.info("no --train_cached_tokens; building corpus df in-process")
-            df, ndocs = build_corpus_df(refs)
-            scorer = CiderD(df_mode="corpus", df=df, ref_len=float(ndocs))
+        scorer = None
+        if getattr(opt, "native_cider", 1):
+            # C++ scorer consumes token ids straight off the rollout.  Its
+            # corpus df is derived from the training refs — identical to the
+            # prepro pickle built from the same refs; pass --native_cider 0
+            # to honor a custom df pickle exactly.
+            try:
+                from ..native import NativeCiderD
+
+                if getattr(opt, "train_cached_tokens", None):
+                    log.warning(
+                        "--train_cached_tokens is ignored by the native "
+                        "scorer (df is rebuilt from this run's training "
+                        "refs); pass --native_cider 0 to honor the pickle"
+                    )
+                scorer = NativeCiderD(refs, self.vocab.word_to_ix)
+                log.info("RL reward: native C++ CIDEr-D (%d videos)",
+                         scorer.num_videos)
+            except Exception as e:  # toolchain missing etc. — fall back
+                log.warning("native CIDEr-D unavailable (%s); using Python", e)
+        if scorer is None:
+            if getattr(opt, "train_cached_tokens", None):
+                scorer = CiderD(df_mode="corpus",
+                                df_path=opt.train_cached_tokens)
+            else:
+                log.info("no --train_cached_tokens; building corpus df in-process")
+                df, ndocs = build_corpus_df(refs)
+                scorer = CiderD(df_mode="corpus", df=df, ref_len=float(ndocs))
         self.reward_computer = RewardComputer(
             self.vocab, scorer, refs,
             seq_per_img=opt.seq_per_img,

@@ -230,6 +230,17 @@ class TestCheckpoint:
         assert int(best.step) == 2
         mgr2.close()
 
+    def test_best_falls_back_to_latest_without_scores(self, setup, tmp_path):
+        # stage trained without a val split: no scores ever recorded
+        _, state, _, _ = setup
+        mgr = CheckpointManager(str(tmp_path / "noval"))
+        mgr.save(5, state.replace(step=jnp.asarray(5)))
+        assert mgr.best_step is None
+        restored = mgr.restore_params(state.params, best=True)
+        assert jax.tree_util.tree_structure(restored) == \
+            jax.tree_util.tree_structure(state.params)
+        mgr.close()
+
     def test_restore_empty_raises(self, setup, tmp_path):
         _, state, _, _ = setup
         mgr = CheckpointManager(str(tmp_path / "empty"))
