@@ -76,6 +76,13 @@ eval:
 	  --beam_size $(BEAM) \
 	  --result_file $(OUT)/$(EXP)_cst_test_scores.json
 
+# ActivityNet-style config: long I3D feature streams + Transformer decoder
+# (driver config 5).  Same artifacts contract, different modality files.
+anet_xe:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --model_type transformer --num_tx_layers 4 --num_heads 8 \
+	  --checkpoint_path $(OUT)/$(EXP)_anet_xe
+
 bench:
 	$(PY) bench.py --stage xe
 

@@ -37,14 +37,21 @@ class NativeUnavailable(RuntimeError):
 def _build() -> None:
     # No -march=native: the .so is cached on disk and a host-specific ISA
     # would SIGILL (uncatchable) if the cache ever moved between machines.
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-o", _LIB, _SRC]
+    # Build to a per-process temp name + rename so concurrent processes
+    # (multi-host shared storage, parallel test workers) never load a
+    # half-written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
     except FileNotFoundError as e:
         raise NativeUnavailable("g++ not available") from e
     except subprocess.CalledProcessError as e:
         raise NativeUnavailable(f"native build failed:\n{e.stderr}") from e
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_library() -> ctypes.CDLL:
@@ -76,6 +83,12 @@ def load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_double),
         ]
+        lib.ciderd_score_loo.restype = ctypes.c_int
+        lib.ciderd_score_loo.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.ciderd_num_refs.restype = ctypes.c_int
+        lib.ciderd_num_refs.argtypes = [ctypes.c_void_p, ctypes.c_int]
         _loaded = lib
         return lib
 
@@ -147,6 +160,11 @@ class NativeCiderD:
         of len(video_ids), rows grouped per video (the rollout layout)."""
         hyps = np.ascontiguousarray(hyps, dtype=np.int32)
         n_hyps, max_len = hyps.shape
+        if n_hyps % len(video_ids) != 0:
+            raise ValueError(
+                f"{n_hyps} hypothesis rows not a multiple of "
+                f"{len(video_ids)} videos — rows must be grouped per video"
+            )
         per_vid = n_hyps // len(video_ids)
         ix = np.asarray(
             [self._video_ix[video_ids[i // per_vid]] for i in range(n_hyps)],
@@ -171,6 +189,23 @@ class NativeCiderD:
         for i, r in enumerate(rows):
             mat[i, : len(r)] = r
         return self.score_ids(video_ids, mat)
+
+    def consensus_scores(self) -> Dict[str, np.ndarray]:
+        """Leave-one-out CIDEr-D of every reference vs its siblings, for all
+        videos — the native fast path behind
+        ``metrics.consensus.compute_consensus_scores``."""
+        out: Dict[str, np.ndarray] = {}
+        for vid, v in self._video_ix.items():
+            r = int(self._lib.ciderd_num_refs(self._handle, v))
+            buf = np.zeros(max(r, 1), dtype=np.float64)
+            rc = self._lib.ciderd_score_loo(
+                self._handle, v,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            )
+            if rc != 0:
+                raise RuntimeError(f"ciderd_score_loo failed with code {rc}")
+            out[vid] = buf[:r] if r else np.zeros(1)
+        return out
 
     @property
     def num_videos(self) -> int:

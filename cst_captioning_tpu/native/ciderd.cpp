@@ -202,4 +202,48 @@ int ciderd_score(void* handle, const int32_t* video_ix, const int32_t* hyps,
   return 0;
 }
 
+// Leave-one-out consensus: out[j] = CIDEr-D of video's reference j scored
+// against its R-1 siblings (df = full corpus) — the offline artifact behind
+// WXE weights and the SCB baseline.  out must hold the video's ref count.
+int ciderd_score_loo(void* handle, int video, double* out) {
+  auto* s = static_cast<Scorer*>(handle);
+  if (!s->finalized) return -1;
+  if (video < 0 || video >= static_cast<int>(s->videos.size())) return -2;
+  const auto& refs = s->videos[video];
+  const int R = static_cast<int>(refs.size());
+  const double inv_2sig2 = 1.0 / (2.0 * s->sigma * s->sigma);
+
+  for (int j = 0; j < R; ++j) {
+    const RefVec& hyp = refs[j];
+    double total = 0.0;
+    for (int r = 0; r < R; ++r) {
+      if (r == j) continue;
+      const RefVec& ref = refs[r];
+      double delta = static_cast<double>(hyp.length - ref.length);
+      double penalty = std::exp(-delta * delta * inv_2sig2);
+      double per_ref = 0.0;
+      for (int k = 0; k < s->n; ++k) {
+        if (hyp.norm[k] == 0.0 || ref.norm[k] == 0.0) continue;
+        double acc = 0.0;
+        for (const auto& [h, hw] : hyp.vec[k]) {
+          auto it = ref.vec[k].find(h);
+          if (it == ref.vec[k].end()) continue;
+          double rw = it->second;
+          acc += (hw < rw ? hw : rw) * rw;
+        }
+        per_ref += acc / (hyp.norm[k] * ref.norm[k]);
+      }
+      total += per_ref / s->n * penalty;
+    }
+    out[j] = R > 1 ? total / (R - 1) * 10.0 : 0.0;
+  }
+  return 0;
+}
+
+int ciderd_num_refs(void* handle, int video) {
+  auto* s = static_cast<Scorer*>(handle);
+  if (video < 0 || video >= static_cast<int>(s->raw.size())) return -1;
+  return static_cast<int>(s->raw[video].size());
+}
+
 }  // extern "C"

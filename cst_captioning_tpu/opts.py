@@ -126,6 +126,22 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--max_checkpoints", type=int, default=2)
     g.add_argument("--log_every", type=int, default=20, help="steps")
     g.add_argument("--loglevel", default="INFO")
+    g.add_argument("--save_every_steps", type=int, default=0,
+                   help="extra checkpoint every N steps for failure "
+                        "recovery (0 = epoch boundaries only)")
+    g.add_argument("--tensorboard", type=int, default=0,
+                   help="1 = write TensorBoard scalars under "
+                        "<checkpoint_path>/tb (train metrics + val scores); "
+                        "a metrics.jsonl is always written regardless")
+    g.add_argument("--profile_dir", default=None,
+                   help="capture a jax.profiler trace of a few steady-state "
+                        "steps into this directory (view with TensorBoard)")
+    g.add_argument("--profile_start", type=int, default=10,
+                   help="step at which the profiler trace starts")
+    g.add_argument("--profile_steps", type=int, default=10,
+                   help="number of steps to trace")
+    g.add_argument("--debug_nans", type=int, default=0,
+                   help="1 = jax_debug_nans (fail fast on NaN; test mode)")
 
 
 def _add_tpu_args(p: argparse.ArgumentParser) -> None:

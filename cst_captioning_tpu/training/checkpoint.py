@@ -48,6 +48,20 @@ class CheckpointManager:
                 create=True,
             ),
         )
+        # Periodic failure-recovery saves live in their own manager: with
+        # best_fn set, orbax exempts metric-less checkpoints from trimming,
+        # so mixing them into the main manager would grow disk unboundedly.
+        self._recovery: Optional[ocp.CheckpointManager] = None
+
+    def _recovery_mgr(self) -> ocp.CheckpointManager:
+        if self._recovery is None:
+            self._recovery = ocp.CheckpointManager(
+                os.path.join(self.directory, "recovery"),
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=1, create=True,
+                ),
+            )
+        return self._recovery
 
     # -- save --------------------------------------------------------------
 
@@ -78,11 +92,32 @@ class CheckpointManager:
         with open(self._infos_path, "w") as f:
             json.dump(self.infos, f, indent=2, default=str)
 
+    def save_recovery(self, step: int, state) -> None:
+        """Periodic crash-recovery save (``--save_every_steps``): keeps only
+        the most recent one, never affects best-score bookkeeping."""
+        mgr = self._recovery_mgr()
+        mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                params=ocp.args.StandardSave(state.params),
+            ),
+        )
+        mgr.wait_until_finished()
+
     # -- restore -----------------------------------------------------------
+
+    def _recovery_latest(self) -> Optional[int]:
+        rec_dir = os.path.join(self.directory, "recovery")
+        if self._recovery is None and not os.path.isdir(rec_dir):
+            return None
+        return self._recovery_mgr().latest_step()
 
     @property
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        cands = [s for s in (self._mgr.latest_step(), self._recovery_latest())
+                 if s is not None]
+        return max(cands, default=None)
 
     @property
     def best_step(self) -> Optional[int]:
@@ -100,6 +135,11 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         return step
 
+    def _mgr_for(self, step: int) -> ocp.CheckpointManager:
+        if step in self._mgr.all_steps():
+            return self._mgr
+        return self._recovery_mgr()
+
     def restore(self, abstract_state, step: Optional[int] = None,
                 best: bool = False):
         """Restore a full train state into the structure of
@@ -107,7 +147,7 @@ class CheckpointManager:
         step = self._resolve_step(step, best)
         target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                         abstract_state)
-        out = self._mgr.restore(
+        out = self._mgr_for(step).restore(
             step,
             args=ocp.args.Composite(state=ocp.args.StandardRestore(target)),
         )
@@ -119,7 +159,7 @@ class CheckpointManager:
         step = self._resolve_step(step, best)
         target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                         abstract_params)
-        out = self._mgr.restore(
+        out = self._mgr_for(step).restore(
             step,
             args=ocp.args.Composite(params=ocp.args.StandardRestore(target)),
         )
@@ -127,3 +167,5 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+        if self._recovery is not None:
+            self._recovery.close()

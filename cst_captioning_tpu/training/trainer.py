@@ -15,7 +15,9 @@ prefetch thread.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -78,6 +80,8 @@ class Trainer:
 
     def __init__(self, opt):
         self.opt = opt
+        if getattr(opt, "debug_nans", 0):
+            jax.config.update("jax_debug_nans", True)
         self.rng = jax.random.PRNGKey(opt.seed)
 
         # -- data ----------------------------------------------------------
@@ -187,6 +191,33 @@ class Trainer:
         self._batch_sharding = batch_sharding(self.mesh)
         self.history: Dict[str, Any] = {"val": []}
 
+        # -- observability: metrics.jsonl always, TensorBoard opt-in -------
+        self._metrics_path = os.path.join(
+            os.path.abspath(opt.checkpoint_path), "metrics.jsonl"
+        )
+        self._tb = None
+        if getattr(opt, "tensorboard", 0):
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(
+                    os.path.join(os.path.abspath(opt.checkpoint_path), "tb")
+                )
+            except Exception as e:
+                log.warning("tensorboard writer unavailable: %s", e)
+
+    def _log_metrics(self, step: int, scope: str,
+                     metrics: Dict[str, float]) -> None:
+        if jax.process_index() != 0:  # one metrics stream per pod
+            return
+        with open(self._metrics_path, "a") as f:
+            f.write(json.dumps(
+                {"step": step, "scope": scope, "time": time.time(), **metrics}
+            ) + "\n")
+        if self._tb is not None:
+            for k, v in metrics.items():
+                self._tb.add_scalar(f"{scope}/{k}", v, step)
+
     # -- RL plumbing -------------------------------------------------------
 
     def _setup_rl(self) -> None:
@@ -291,7 +322,16 @@ class Trainer:
         t0 = time.time()
         captions_done = 0
 
+        profiling = False
         for step in range(start_step, total_steps):
+            if opt.profile_dir:
+                if step == opt.profile_start and not profiling:
+                    jax.profiler.start_trace(opt.profile_dir)
+                    profiling = True
+                elif profiling and step == opt.profile_start + opt.profile_steps:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    log.info("profiler trace written to %s", opt.profile_dir)
             batch = next(it)
             metrics = (self._rl_iteration(batch) if opt.use_rl
                        else self._xe_iteration(batch))
@@ -300,14 +340,23 @@ class Trainer:
             if (step + 1) % opt.log_every == 0:
                 m = {k: float(v) for k, v in metrics.items()}
                 dt = time.time() - t0
+                cps = captions_done / max(dt, 1e-9)
                 log.info(
                     "step %d/%d epoch %.2f %s lr %.2e | %.0f captions/s",
                     step + 1, total_steps, (step + 1) / bpe,
                     " ".join(f"{k} {v:.4f}" for k, v in m.items()),
                     float(self.lr_sched(step)),
-                    captions_done / max(dt, 1e-9),
+                    cps,
                 )
+                self._log_metrics(step + 1, "train",
+                                  {**m, "lr": float(self.lr_sched(step)),
+                                   "captions_per_sec": cps})
                 t0, captions_done = time.time(), 0
+
+            if (opt.save_every_steps
+                    and (step + 1) % opt.save_every_steps == 0
+                    and (step + 1) % bpe != 0):  # epoch boundary saves below
+                self.ckpt.save_recovery(step + 1, self.state)
 
             if (step + 1) % bpe == 0:  # epoch boundary
                 scores = self.validate()
@@ -316,6 +365,7 @@ class Trainer:
                     self.history["val"].append(
                         {"step": step + 1, **scores}
                     )
+                    self._log_metrics(step + 1, "val", scores)
                     log.info("val @ step %d: %s", step + 1,
                              {k: round(v, 4) for k, v in scores.items()})
                     self.ckpt.save(step + 1, self.state, score=metric,
@@ -332,6 +382,8 @@ class Trainer:
                 else:
                     self.ckpt.save(step + 1, self.state)
 
+        if profiling:  # run ended inside the trace window
+            jax.profiler.stop_trace()
         return {
             "best_score": None if best == float("-inf") else best,
             "best_step": self.ckpt.best_step,
@@ -340,6 +392,8 @@ class Trainer:
         }
 
     def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
         self.ckpt.close()
         self.train_ds.close()
         if self.val_ds:

@@ -113,6 +113,13 @@ class TestEdgeCases:
         with pytest.raises(KeyError):
             native_scorer.score_ids(["nope"], np.zeros((1, 4), np.int32))
 
+    def test_non_multiple_rows_raises(self, refs, native_scorer):
+        vids = list(refs.keys())[:4]
+        with pytest.raises(ValueError, match="multiple"):
+            native_scorer.score_ids(vids, np.zeros((10, 4), np.int32))
+        with pytest.raises(ValueError, match="multiple"):
+            native_scorer.score_ids(vids, np.zeros((3, 4), np.int32))
+
     def test_multiple_hyps_per_video_grouping(self, refs, native_scorer):
         video_ids = list(refs.keys())[:2]
         # 2 hyps per video: [v0 ref, garbage, v1 ref, garbage]
@@ -121,6 +128,25 @@ class TestEdgeCases:
         out = native_scorer.score_strings(video_ids, caps)
         assert out[0] > out[1]
         assert out[2] > out[3]
+
+
+class TestConsensusLOO:
+    def test_matches_python_consensus(self, refs):
+        from cst_captioning_tpu.metrics.consensus import compute_consensus_scores
+
+        py = compute_consensus_scores(refs, native=False)
+        nat = NativeCiderD(refs).consensus_scores()
+        assert set(py) == set(nat)
+        for vid in py:
+            np.testing.assert_allclose(nat[vid], py[vid],
+                                       rtol=1e-9, atol=1e-12)
+
+    def test_single_caption_video_scores_zero(self):
+        refs = {"v0": ["a man is cooking"], "v1": ["a dog runs", "dog runs"]}
+        out = NativeCiderD(refs).consensus_scores()
+        np.testing.assert_allclose(out["v0"], [0.0])
+        assert out["v1"].shape == (2,)
+        assert (out["v1"] > 0).all()  # overlapping siblings score nonzero
 
 
 class TestRewardComputerIntegration:

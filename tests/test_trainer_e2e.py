@@ -132,6 +132,41 @@ def test_full_pipeline(data, tmp_path_factory):
     assert len(blob["predictions"]) == 4  # deduped to the split's videos
 
 
+def test_transformer_decoder_stage(data, tmp_path_factory):
+    """Driver config 5: Transformer-decoder swap behind the same CLI."""
+    out = str(tmp_path_factory.mktemp("tx"))
+    ckpt = os.path.join(out, "tx_xe")
+    res = run_stage(
+        data, ckpt,
+        **{"--model_type": ["transformer"],
+           "--num_heads": ["2"], "--num_tx_layers": ["2"],
+           "--max_epochs": ["1"]},
+    )
+    assert res["best_score"] is not None
+
+    # RL stage + beam eval must also work on the transformer carry
+    res_rl = run_stage(
+        data, os.path.join(out, "tx_cst"),
+        **{"--model_type": ["transformer"],
+           "--num_heads": ["2"], "--num_tx_layers": ["2"],
+           "--start_from": [ckpt],
+           "--use_rl": ["1"], "--max_epochs": ["1"]},
+    )
+    assert res_rl["best_score"] is not None
+
+    import eval as eval_cli
+    t = data["val"]
+    rc = eval_cli.main([
+        "--checkpoint_path", ckpt,
+        "--test_feat_h5", *json.loads(t["feat_h5"]),
+        "--test_label_h5", t["label_h5"],
+        "--test_info_json", t["info_json"],
+        "--test_cocofmt_file", t["cocofmt_json"],
+        "--beam_size", "2", "--batch_size", "4", "--max_length", "12",
+    ])
+    assert rc == 0
+
+
 def test_scb_sample_stage(data, tmp_path_factory):
     out = str(tmp_path_factory.mktemp("scb"))
     res = run_stage(

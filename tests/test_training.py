@@ -241,6 +241,27 @@ class TestCheckpoint:
             jax.tree_util.tree_structure(state.params)
         mgr.close()
 
+    def test_recovery_saves_trim_and_resume(self, setup, tmp_path):
+        _, state, _, _ = setup
+        d = str(tmp_path / "rec")
+        mgr = CheckpointManager(d, max_to_keep=2)
+        mgr.save(2, state.replace(step=jnp.asarray(2)), score=0.5)
+        # periodic recovery saves: only the newest survives, best untouched
+        mgr.save_recovery(3, state.replace(step=jnp.asarray(3)))
+        mgr.save_recovery(5, state.replace(step=jnp.asarray(5)))
+        assert mgr.best_step == 2
+        assert mgr.latest_step == 5  # recovery step wins as resume point
+        restored = mgr.restore(state)
+        assert int(restored.step) == 5
+        # best restore still routes to the scored main checkpoint
+        best = mgr.restore(state, best=True)
+        assert int(best.step) == 2
+        mgr.close()
+        import os
+        rec_steps = [p for p in os.listdir(os.path.join(d, "recovery"))
+                     if p.isdigit()]
+        assert rec_steps == ["5"]  # max_to_keep=1 trimmed step 3
+
     def test_restore_empty_raises(self, setup, tmp_path):
         _, state, _, _ = setup
         mgr = CheckpointManager(str(tmp_path / "empty"))
