@@ -67,12 +67,22 @@ class CheckpointManager:
 
     def save(self, step: int, state, score: Optional[float] = None,
              extra: Optional[Dict[str, Any]] = None) -> None:
-        """Save state; update best bookkeeping when ``score`` improves."""
-        metrics = {"score": float(score)} if score is not None else None
+        """Save state; update best bookkeeping when ``score`` improves.
+
+        Scored saves go to the best_fn-managed main manager.  Score-less
+        saves (stage without a val split) go to the recovery manager —
+        orbax exempts metric-less checkpoints from best_fn trimming, so
+        keeping them in the main manager would grow disk one full
+        TrainState per epoch regardless of max_to_keep.
+        """
+        if score is None:
+            mgr, metrics = self._recovery_mgr(), None
+        else:
+            mgr, metrics = self._mgr, {"score": float(score)}
         # ``params`` is saved as its own entry so the next stage can
         # warm-start weights without matching this stage's optimizer
         # structure (XE -> WXE -> CST chaining, SURVEY.md §5).
-        self._mgr.save(
+        mgr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(state),
@@ -80,7 +90,7 @@ class CheckpointManager:
             ),
             metrics=metrics,
         )
-        self._mgr.wait_until_finished()
+        mgr.wait_until_finished()
         if score is not None and (
             self.infos["best_score"] is None or score > self.infos["best_score"]
         ):

@@ -196,14 +196,14 @@ class Trainer:
             os.path.abspath(opt.checkpoint_path), "metrics.jsonl"
         )
         self._tb = None
-        if getattr(opt, "tensorboard", 0):
+        if getattr(opt, "tensorboard", 0) and jax.process_index() == 0:
             try:
-                from torch.utils.tensorboard import SummaryWriter
+                from ..utils.tb import ScalarWriter
 
-                self._tb = SummaryWriter(
+                self._tb = ScalarWriter(
                     os.path.join(os.path.abspath(opt.checkpoint_path), "tb")
                 )
-            except Exception as e:
+            except ImportError as e:  # tensorboard pkg not installed
                 log.warning("tensorboard writer unavailable: %s", e)
 
     def _log_metrics(self, step: int, scope: str,

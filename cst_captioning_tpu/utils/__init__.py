@@ -1,0 +1,5 @@
+"""Shared utilities (scalar logging, misc helpers)."""
+
+from .tb import ScalarWriter
+
+__all__ = ["ScalarWriter"]

@@ -1,0 +1,49 @@
+"""Torch-free TensorBoard scalar writer.
+
+The framework runs with no PyTorch in the loop (README), so TB scalars are
+written through the ``tensorboard`` package's own event-file writer rather
+than ``torch.utils.tensorboard``.  Only scalars are needed (train metrics +
+val scores); anything fancier belongs in the profiler trace.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ScalarWriter:
+    """Minimal add_scalar/flush/close over tensorboard's EventFileWriter.
+
+    Raises ImportError at construction if the tensorboard package is not
+    installed — callers decide whether that is fatal (the trainer warns and
+    continues; metrics.jsonl is always written regardless).
+    """
+
+    def __init__(self, logdir: str):
+        from tensorboard.compat.proto.event_pb2 import Event
+        from tensorboard.compat.proto.summary_pb2 import Summary
+        from tensorboard.summary.writer.event_file_writer import (
+            EventFileWriter,
+        )
+
+        self._Event = Event
+        self._Summary = Summary
+        self._writer = EventFileWriter(logdir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        event = self._Event(
+            step=int(step),
+            wall_time=time.time(),
+            summary=self._Summary(
+                value=[self._Summary.Value(tag=tag,
+                                           simple_value=float(value))]
+            ),
+        )
+        self._writer.add_event(event)
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.flush()
+        self._writer.close()

@@ -1,0 +1,77 @@
+"""Dataset-format converters -> prepro annotation contract."""
+
+import json
+
+import pytest
+
+from cst_captioning_tpu.data.converters import (
+    convert_activitynet,
+    convert_msrvtt,
+    convert_msvd,
+)
+from cst_captioning_tpu.data.prepro import build_split
+
+
+class TestMSRVTT:
+    def _blob(self):
+        return {
+            "videos": [
+                {"video_id": "video0", "split": "train"},
+                {"video_id": "video1", "split": "validate"},
+                {"video_id": "video2", "split": "test"},
+            ],
+            "sentences": [
+                {"video_id": "video0", "caption": "a man is cooking"},
+                {"video_id": "video0", "caption": "someone cooks food"},
+                {"video_id": "video1", "caption": "a dog runs"},
+                {"video_id": "video2", "caption": "a cat sleeps"},
+            ],
+        }
+
+    def test_split_routing(self):
+        out = convert_msrvtt(self._blob())
+        assert [v["id"] for v in out["train"]] == ["video0"]
+        assert [v["id"] for v in out["val"]] == ["video1"]
+        assert [v["id"] for v in out["test"]] == ["video2"]
+        assert len(out["train"][0]["captions"]) == 2
+
+    def test_feeds_prepro(self, tmp_path):
+        out = convert_msrvtt(self._blob())
+        paths = build_split(out["train"], str(tmp_path), "train", max_len=8)
+        assert json.load(open(paths["info_json"]))["videos"] == [{"id": "video0"}]
+
+
+class TestMSVD:
+    # public MSVD caption files are tab-separated; spaces must work too
+    LINES = [f"vid{i}\tcaption number {i}\n" for i in range(20)] + [
+        "vid0 another caption for clip zero\n", "", "   \n",
+    ]
+
+    def test_official_splits(self):
+        out = convert_msvd(
+            self.LINES,
+            splits={"train": ["vid0", "vid1"], "test": ["vid2"]},
+        )
+        assert {v["id"] for v in out["train"]} == {"vid0", "vid1"}
+        assert len([c for v in out["train"] if v["id"] == "vid0"
+                    for c in v["captions"]]) == 2
+
+    def test_proportional_split_deterministic(self):
+        a = convert_msvd(self.LINES)
+        b = convert_msvd(self.LINES)
+        assert a == b
+        total = sum(len(a[s]) for s in ("train", "val", "test"))
+        assert total == 20
+        assert len(a["train"]) == 12  # int(20 * 1200/1970)
+        assert len(a["val"]) == 1     # int(20 * 100/1970)
+
+
+class TestActivityNet:
+    def test_convert(self):
+        out = convert_activitynet({
+            "train": {"v_abc": {"sentences": [" A man runs. ", "He jumps."]}},
+            "val": {"v_def": {"sentences": ["A dog barks."]}},
+        })
+        assert out["train"][0]["id"] == "v_abc"
+        assert out["train"][0]["captions"] == ["A man runs.", "He jumps."]
+        assert out["val"][0]["captions"] == ["A dog barks."]

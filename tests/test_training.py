@@ -103,6 +103,36 @@ class TestXEStep:
         assert float(m_flat["loss"]) != pytest.approx(float(m_wxe["loss"]))
 
 
+class TestBFloat16:
+    """--use_bfloat16: bf16 compute on the MXU, fp32 params/updates."""
+
+    def test_bf16_trains_and_decodes(self, vocab):
+        model = CaptionModel(vocab_size=vocab.size_with_pad, embed_size=16,
+                             hidden_size=16, attn_size=16, dropout_rate=0.0,
+                             dtype=jnp.bfloat16)
+        tx, _ = make_optimizer(learning_rate=1e-2)
+        state = create_train_state(model, jax.random.PRNGKey(0), [(3, 8)],
+                                   L, S, tx, batch_size=B)
+        # flax keeps params fp32 when only compute dtype is bf16
+        assert jax.tree_util.tree_leaves(state.params)[0].dtype == jnp.float32
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (B, 3, 8))]
+        labels = jnp.array([[1, 2, 3, 0, 0, 0]] * (B * S), dtype=jnp.int32)
+        step = jax.jit(make_xe_step(model, S))
+        first = None
+        for _ in range(40):
+            state, m = step(state, feats, labels, jnp.ones((B * S,)),
+                            jax.random.PRNGKey(2))
+            if first is None:
+                first = float(m["loss"])
+        assert float(m["loss"]) < first
+        from cst_captioning_tpu.ops.beam import beam_search
+
+        best, _, scores = beam_search(model, {"params": state.params},
+                                      feats, 3, L)
+        assert best.shape == (B, L) and best.dtype == jnp.int32
+        assert np.isfinite(np.asarray(scores, np.float32)).all()
+
+
 class TestRewards:
     def _computer(self, vocab, baseline="greedy", **kw):
         refs = {"v0": ["a man is cooking"], "v1": ["a dog runs"]}
@@ -200,6 +230,24 @@ class TestRLStep:
             np.asarray(jax.tree_util.tree_leaves(new_state.params)[0]),
             np.asarray(jax.tree_util.tree_leaves(state.params)[0]),
         )
+
+
+class TestScalarWriter:
+    def test_writes_event_file(self, tmp_path):
+        pytest.importorskip("tensorboard")
+        from cst_captioning_tpu.utils.tb import ScalarWriter
+
+        d = str(tmp_path / "tb")
+        w = ScalarWriter(d)
+        w.add_scalar("train/loss", 1.5, 1)
+        w.add_scalar("val/CIDEr", 0.4, 2)
+        w.close()
+        import glob
+        import os
+
+        files = glob.glob(d + "/events.out.tfevents.*")
+        assert len(files) == 1
+        assert os.path.getsize(files[0]) > 0
 
 
 class TestCheckpoint:
