@@ -54,6 +54,7 @@ class CaptionModel(nn.Module):
     tx_max_len: int = 64            # transformer only: positional-table size;
                                     # must cover the label seq_length
     dtype: jnp.dtype = jnp.float32
+    use_pallas_attention: bool = False  # fused VMEM attention kernel (lstm)
 
     def setup(self):
         self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
@@ -70,6 +71,7 @@ class CaptionModel(nn.Module):
                 use_attention=self.use_attention,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
+                use_pallas_attention=self.use_pallas_attention,
                 name="cell",
             )
             self.state_init = [

@@ -40,6 +40,7 @@ class DecoderCell(nn.Module):
     use_attention: bool = True
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    use_pallas_attention: bool = False
 
     @nn.compact
     def __call__(
@@ -55,8 +56,10 @@ class DecoderCell(nn.Module):
                      name="embed")(token)
         h_top = carry[-1][1]
         if self.use_attention:
-            context, _ = AdditiveAttention(self.attn_size, dtype=self.dtype,
-                                           name="attn")(h_top, memory, proj_mem)
+            context, _ = AdditiveAttention(
+                self.attn_size, dtype=self.dtype,
+                use_pallas=self.use_pallas_attention, name="attn",
+            )(h_top, memory, proj_mem)
         else:
             context = pooled
         inp = jnp.concatenate([x, context.astype(self.dtype)], axis=-1)

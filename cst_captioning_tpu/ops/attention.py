@@ -10,11 +10,17 @@ encoder output, so the *caller* computes it once per sequence with a plain
 ``nn.Dense`` and passes it into every step; this module holds only the
 per-step parameters (query projection + score vector), keeping the inner
 decode loop at one (B,H)x(H,A) matmul.
+
+``use_pallas=True`` routes the score -> softmax -> context chain through
+the fused VMEM kernel (ops/pallas_attention.py): same parameters, same
+math, custom-VJP gradients.  Interpret-mode parity with the XLA path is
+pinned by tests/test_pallas_attention.py.
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -23,6 +29,7 @@ class AdditiveAttention(nn.Module):
 
     attn_size: int
     dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(
@@ -32,10 +39,33 @@ class AdditiveAttention(nn.Module):
         projected_memory: jnp.ndarray,  # (B, T, A) precomputed W_m . memory
     ):
         q = nn.Dense(self.attn_size, use_bias=False, dtype=self.dtype,
-                     name="query_proj")(query)[:, None, :]           # (B, 1, A)
-        scores = nn.Dense(1, use_bias=False, dtype=self.dtype, name="score")(
-            jnp.tanh(projected_memory + q)
-        )[..., 0]                                                     # (B, T)
-        weights = nn.softmax(scores, axis=-1)
-        context = jnp.einsum("bt,bth->bh", weights, memory.astype(self.dtype))
-        return context, weights
+                     name="query_proj")(query)                       # (B, A)
+        # The score vector is a bare (A,) param shared by the pallas and XLA
+        # branches — one param-tree layout regardless of the flag.
+        v = self.param(
+            "score_v",
+            nn.initializers.normal(stddev=self.attn_size ** -0.5),
+            (self.attn_size,), jnp.float32,
+        )
+        if self.use_pallas and not self.is_initializing():
+            from .pallas_attention import (
+                default_interpret,
+                fused_additive_attention,
+            )
+
+            # Inputs stay in their storage dtype (bf16 reads bf16 from HBM);
+            # the kernel accumulates scores/softmax/context in fp32.
+            context, weights = fused_additive_attention(
+                q, projected_memory, memory, v,
+                interpret=default_interpret(),
+            )
+            return context.astype(self.dtype), weights.astype(self.dtype)
+        # Match the kernel's numerics: fp32 scores, softmax and context.
+        scores = jnp.einsum(
+            "bta,a->bt",
+            jnp.tanh(projected_memory + q[:, None, :]).astype(jnp.float32), v
+        )
+        weights = jax.nn.softmax(scores, axis=-1)
+        context = jnp.einsum("bt,bth->bh", weights,
+                             memory.astype(jnp.float32))
+        return context.astype(self.dtype), weights.astype(self.dtype)

@@ -1,0 +1,154 @@
+"""Pallas TPU kernel: fused additive-attention step for the decoder.
+
+The attention-LSTM hot loop computes, per decode step,
+``softmax(v . tanh(proj_mem + W_q h)) @ memory``.  Unfused, XLA materializes
+the (B, T, A) tanh activation in HBM between two kernels; this Pallas
+kernel keeps the whole score -> softmax -> context chain in VMEM per batch
+block, reading proj_mem/memory once (the op is HBM-bandwidth-bound — the
+tanh tensor alone is B*T*A*4 bytes per step).
+
+Layout (pallas_guide.md: grid/BlockSpec, VMEM, MXU preferred_element_type):
+- caller performs the (B,H)x(H,A) query projection as a plain GEMM (MXU
+  likes one big matmul; fusing it here would re-load W_q per block);
+- grid over batch blocks; per block the kernel holds (Bb, T, A) proj_mem +
+  (Bb, T, H) memory in VMEM;
+- score reduction and the context weighted-sum both lower to MXU dots.
+
+Training needs gradients and ``pallas_call`` is not auto-differentiable, so
+the op carries a custom VJP whose backward is plain fused XLA (recomputes
+tanh from the saved inputs — cheaper than storing it, same recompute trade
+as jax.checkpoint).
+
+``interpret=True`` (automatic off-TPU) runs the kernel through the Pallas
+interpreter so CPU tests cover the exact kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; unavailable in some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+
+def _block_spec(shape, index_map):
+    if _VMEM is None:  # pragma: no cover - interpret mode only
+        return pl.BlockSpec(shape, index_map)
+    return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+
+
+def _attention_kernel(q_ref, pm_ref, mem_ref, v_ref, ctx_ref, w_ref):
+    # HBM reads stay in the storage dtype (bf16 inputs read bf16); all the
+    # math below runs in fp32 registers/VMEM.
+    q = q_ref[:].astype(jnp.float32)             # (Bb, A)
+    pm = pm_ref[:].astype(jnp.float32)           # (Bb, T, A)
+    v = v_ref[:].astype(jnp.float32)             # (1, A)
+    bb, t, a = pm.shape
+    tanh = jnp.tanh(pm + q[:, None, :])
+    # (Bb*T, A) @ (A, 1) -> scores: one MXU dot instead of a VPU reduction.
+    scores = jax.lax.dot_general(
+        tanh.reshape(bb * t, a), v.reshape(a, 1),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).reshape(bb, t)
+    w = jax.nn.softmax(scores, axis=-1)
+    # batched (Bb, T) x (Bb, T, H) -> (Bb, H)
+    ctx = jax.lax.dot_general(
+        w, mem_ref[:].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    ctx_ref[:] = ctx.astype(ctx_ref.dtype)
+    w_ref[:] = w.astype(w_ref.dtype)
+
+
+def _forward(query_proj, proj_mem, memory, score_v, block_b, interpret):
+    b, t, a = proj_mem.shape
+    h = memory.shape[-1]
+    bb = min(block_b, b)
+    pad = (-b) % bb
+    if pad:
+        query_proj = jnp.pad(query_proj, ((0, pad), (0, 0)))
+        proj_mem = jnp.pad(proj_mem, ((0, pad), (0, 0), (0, 0)))
+        memory = jnp.pad(memory, ((0, pad), (0, 0), (0, 0)))
+    bp = b + pad
+    grid = (bp // bb,)
+    ctx, w = pl.pallas_call(
+        _attention_kernel,
+        grid=grid,
+        in_specs=[
+            _block_spec((bb, a), lambda i: (i, 0)),
+            _block_spec((bb, t, a), lambda i: (i, 0, 0)),
+            _block_spec((bb, t, h), lambda i: (i, 0, 0)),
+            _block_spec((1, a), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _block_spec((bb, h), lambda i: (i, 0)),
+            _block_spec((bb, t), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, h), memory.dtype),
+            jax.ShapeDtypeStruct((bp, t), memory.dtype),
+        ],
+        interpret=interpret,
+    )(query_proj, proj_mem, memory, score_v.reshape(1, -1))
+    return ctx[:b], w[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_additive_attention(
+    query_proj: jnp.ndarray,   # (B, A) — W_q h, projected by the caller
+    proj_mem: jnp.ndarray,     # (B, T, A) — W_m memory, projected once
+    memory: jnp.ndarray,       # (B, T, H)
+    score_v: jnp.ndarray,      # (A,) score vector
+    block_b: int = 8,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (context (B, H), weights (B, T))."""
+    return _forward(query_proj, proj_mem, memory, score_v, block_b, interpret)
+
+
+def _fwd(query_proj, proj_mem, memory, score_v, block_b, interpret):
+    ctx, w = _forward(query_proj, proj_mem, memory, score_v, block_b,
+                      interpret)
+    return (ctx, w), (query_proj, proj_mem, memory, score_v, w)
+
+
+def _bwd(block_b, interpret, res, grads):
+    query_proj, proj_mem, memory, score_v, w = res
+    g_ctx = grads[0].astype(jnp.float32)
+    g_w = grads[1].astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    memory_f = memory.astype(jnp.float32)
+    # Recompute tanh (checkpoint-style) — fused by XLA, nothing stored.
+    tanh = jnp.tanh(
+        (proj_mem + query_proj[:, None, :]).astype(jnp.float32)
+    )                                                        # (B, T, A)
+    g_w_total = g_w + jnp.einsum("bh,bth->bt", g_ctx, memory_f)
+    # softmax backward: ds = w * (g - sum_t w g)
+    ds = w * (g_w_total - jnp.sum(w * g_w_total, axis=-1, keepdims=True))
+    dt = (ds[:, :, None] * score_v.astype(jnp.float32)[None, None, :]
+          * (1.0 - tanh * tanh))
+    g_pm = dt
+    g_q = dt.sum(axis=1)
+    g_v = jnp.einsum("bta,bt->a", tanh, ds)
+    g_mem = jnp.einsum("bt,bh->bth", w, g_ctx)
+    return (g_q.astype(query_proj.dtype), g_pm.astype(proj_mem.dtype),
+            g_mem.astype(memory.dtype), g_v.astype(score_v.dtype))
+
+
+fused_additive_attention.defvjp(_fwd, _bwd)
+
+
+def default_interpret() -> bool:
+    """Interpret off-TPU so CPU tests execute the kernel path."""
+    return jax.default_backend() != "tpu"

@@ -57,6 +57,9 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--num_tx_layers", type=int, default=2, help="transformer")
     g.add_argument("--use_bfloat16", type=int, default=0,
                    help="compute in bfloat16 (MXU-native) with fp32 params")
+    g.add_argument("--pallas_attention", type=int, default=0,
+                   help="1 = fused Pallas VMEM attention kernel in the LSTM "
+                        "decoder (interpret-mode off TPU)")
 
 
 def _add_optim_args(p: argparse.ArgumentParser) -> None:

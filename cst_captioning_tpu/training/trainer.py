@@ -58,6 +58,7 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
         num_tx_layers=opt.num_tx_layers,
         tx_max_len=max(seq_length + 1, opt.max_length + 1),
         dtype=jnp.bfloat16 if opt.use_bfloat16 else jnp.float32,
+        use_pallas_attention=bool(getattr(opt, "pallas_attention", 0)),
     )
 
 

@@ -1,0 +1,129 @@
+"""Pallas fused attention: interpret-mode parity with the XLA path.
+
+Forward values, gradients (custom VJP), and the full DecoderCell/CaptionModel
+integration must match the plain flax computation — the kernel is a pure
+performance substitution (SURVEY.md §7 step 8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.pallas_attention import fused_additive_attention
+
+B, T, A, H = 5, 7, 16, 12  # deliberately unaligned (pads to block_b)
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 4)
+    return (
+        jax.random.normal(ks[0], (B, A)),        # query_proj
+        jax.random.normal(ks[1], (B, T, A)),     # proj_mem
+        jax.random.normal(ks[2], (B, T, H)),     # memory
+        jax.random.normal(ks[3], (A,)),          # score_v
+    )
+
+
+def reference(q, pm, mem, v):
+    scores = jnp.einsum("bta,a->bt", jnp.tanh(pm + q[:, None, :]), v)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bt,bth->bh", w, mem), w
+
+
+class TestForward:
+    def test_matches_reference(self, inputs):
+        ctx, w = fused_additive_attention(*inputs, block_b=2, interpret=True)
+        ref_ctx, ref_w = reference(*inputs)
+        np.testing.assert_allclose(np.asarray(ctx), np.asarray(ref_ctx),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(w), np.asarray(ref_w),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_weights_normalized(self, inputs):
+        _, w = fused_additive_attention(*inputs, block_b=4, interpret=True)
+        np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
+
+    def test_block_size_invariance(self, inputs):
+        a, _ = fused_additive_attention(*inputs, block_b=1, interpret=True)
+        b, _ = fused_additive_attention(*inputs, block_b=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_jit_compatible(self, inputs):
+        fn = jax.jit(lambda *a: fused_additive_attention(
+            *a, block_b=2, interpret=True)[0])
+        np.testing.assert_allclose(
+            np.asarray(fn(*inputs)),
+            np.asarray(reference(*inputs)[0]), rtol=1e-5, atol=1e-6,
+        )
+
+
+class TestBF16:
+    def test_bf16_inputs_stay_bf16_and_match(self, inputs):
+        q, pm, mem = (x.astype(jnp.bfloat16) for x in inputs[:3])
+        v = inputs[3]
+        ctx, w = fused_additive_attention(q, pm, mem, v, 2, True)
+        assert ctx.dtype == jnp.bfloat16  # storage dtype preserved
+        ref_ctx, _ = reference(q.astype(jnp.float32), pm.astype(jnp.float32),
+                               mem.astype(jnp.float32), v)
+        np.testing.assert_allclose(np.asarray(ctx, np.float32),
+                                   np.asarray(ref_ctx), rtol=5e-2, atol=5e-2)
+
+
+class TestGradients:
+    def test_vjp_matches_reference_grads(self, inputs):
+        target = jax.random.normal(jax.random.PRNGKey(9), (B, H))
+
+        def loss_pallas(q, pm, mem, v):
+            ctx, w = fused_additive_attention(q, pm, mem, v, 2, True)
+            return jnp.sum((ctx - target) ** 2) + jnp.sum(w * w)
+
+        def loss_ref(q, pm, mem, v):
+            ctx, w = reference(q, pm, mem, v)
+            return jnp.sum((ctx - target) ** 2) + jnp.sum(w * w)
+
+        g_p = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(*inputs)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(*inputs)
+        for a, b in zip(g_p, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestModelIntegration:
+    def test_captioner_logits_match(self):
+        labels = jnp.array([[3, 4, 5, 0, 0, 0], [6, 7, 0, 0, 0, 0]])
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))]
+        kw = dict(vocab_size=12, embed_size=16, hidden_size=16,
+                  attn_size=16, dropout_rate=0.0)
+        plain = CaptionModel(**kw)
+        fused = CaptionModel(**kw, use_pallas_attention=True)
+        variables = plain.init(jax.random.PRNGKey(0), feats, labels)
+        # identical param trees: the flag changes compute only
+        logits_plain = plain.apply(variables, feats, labels)
+        logits_fused = fused.apply(variables, feats, labels)
+        np.testing.assert_allclose(np.asarray(logits_fused),
+                                   np.asarray(logits_plain),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow_through_model(self):
+        labels = jnp.array([[3, 4, 0, 0], [6, 7, 2, 0]])
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (2, 3, 8))]
+        model = CaptionModel(vocab_size=12, embed_size=8, hidden_size=8,
+                             attn_size=8, dropout_rate=0.0,
+                             use_pallas_attention=True)
+        variables = model.init(jax.random.PRNGKey(0), feats, labels)
+
+        def loss(params):
+            logits = model.apply({"params": params}, feats, labels)
+            return jnp.mean(logits ** 2)
+
+        grads = jax.grad(loss)(variables["params"])
+        leaves = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+        # attention params receive nonzero grads
+        attn = grads["cell"]["attn"]
+        assert float(jnp.abs(attn["score_v"]).max()) > 0
+        assert float(jnp.abs(attn["query_proj"]["kernel"]).max()) > 0
