@@ -43,9 +43,16 @@ class SplitPaths:
 
 
 class CaptionDataset:
-    """Random-access view over one split's HDF5 feature + label files."""
+    """Random-access view over one split's HDF5 feature + label files.
 
-    def __init__(self, paths: SplitPaths):
+    ``preload=True`` reads every feature array into RAM once — h5py random
+    access is the input pipeline's only per-batch disk cost, and MSR-VTT-
+    scale features (a few GB) fit host memory comfortably, so preloading
+    removes the last IO from the 5k captions/sec/chip path (SURVEY.md §7
+    hard part (e)).
+    """
+
+    def __init__(self, paths: SplitPaths, preload: bool = False):
         self.paths = paths
         with open(paths.info_json) as f:
             info = json.load(f)
@@ -57,11 +64,19 @@ class CaptionDataset:
             self._feat_files = [h5py.File(p, "r") for p in paths.feat_h5]
             opened.extend(self._feat_files)
             self._feats = [f["feats"] for f in self._feat_files]
+            if preload:
+                self._feats = [np.asarray(f, dtype=np.float32)
+                               for f in self._feats]
+                for f in self._feat_files:
+                    f.close()
+                self._feat_files = []
             self._label_file = h5py.File(paths.label_h5, "r")
             opened.append(self._label_file)
             self.labels = self._label_file["labels"]          # (M, L)
             self.label_start = np.asarray(self._label_file["label_start_ix"])
             self.label_end = np.asarray(self._label_file["label_end_ix"])
+            if preload:  # label matrix is tiny (M x L int32)
+                self.labels = np.asarray(self.labels, dtype=np.int32)
 
             n = len(self.video_ids)
             for feats, path in zip(self._feats, paths.feat_h5):

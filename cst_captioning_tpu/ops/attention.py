@@ -60,10 +60,12 @@ class AdditiveAttention(nn.Module):
                 interpret=default_interpret(),
             )
             return context.astype(self.dtype), weights.astype(self.dtype)
-        # Match the kernel's numerics: fp32 scores, softmax and context.
+        # Match the kernel's numerics: operands cast to fp32 BEFORE the add
+        # (not after a bf16 add), fp32 scores, softmax and context.
         scores = jnp.einsum(
             "bta,a->bt",
-            jnp.tanh(projected_memory + q[:, None, :]).astype(jnp.float32), v
+            jnp.tanh(projected_memory.astype(jnp.float32)
+                     + q.astype(jnp.float32)[:, None, :]), v
         )
         weights = jax.nn.softmax(scores, axis=-1)
         context = jnp.einsum("bt,bth->bh", weights,

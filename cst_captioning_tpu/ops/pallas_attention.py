@@ -120,19 +120,21 @@ def fused_additive_attention(
 def _fwd(query_proj, proj_mem, memory, score_v, block_b, interpret):
     ctx, w = _forward(query_proj, proj_mem, memory, score_v, block_b,
                       interpret)
-    return (ctx, w), (query_proj, proj_mem, memory, score_v, w)
+    return (ctx, w), (query_proj, proj_mem, memory, score_v)
 
 
 def _bwd(block_b, interpret, res, grads):
-    query_proj, proj_mem, memory, score_v, w = res
+    query_proj, proj_mem, memory, score_v = res
     g_ctx = grads[0].astype(jnp.float32)
     g_w = grads[1].astype(jnp.float32)
-    w = w.astype(jnp.float32)
     memory_f = memory.astype(jnp.float32)
-    # Recompute tanh (checkpoint-style) — fused by XLA, nothing stored.
-    tanh = jnp.tanh(
-        (proj_mem + query_proj[:, None, :]).astype(jnp.float32)
-    )                                                        # (B, T, A)
+    # Recompute tanh and the softmax weights in fp32 exactly as the forward
+    # kernel computed them (operands cast BEFORE the add) — checkpoint-style
+    # recompute, and no bf16-rounded residual enters the gradient.
+    tanh = jnp.tanh(proj_mem.astype(jnp.float32)
+                    + query_proj.astype(jnp.float32)[:, None, :])  # (B, T, A)
+    scores = jnp.einsum("bta,a->bt", tanh, score_v.astype(jnp.float32))
+    w = jax.nn.softmax(scores, axis=-1)
     g_w_total = g_w + jnp.einsum("bh,bth->bt", g_ctx, memory_f)
     # softmax backward: ds = w * (g - sum_t w g)
     ds = w * (g_w_total - jnp.sum(w * g_w_total, axis=-1, keepdims=True))

@@ -36,6 +36,9 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
                    help="0 = use --batch_size")
     g.add_argument("--seq_per_img", type=int, default=20,
                    help="captions per video per batch")
+    g.add_argument("--preload_feats", type=int, default=0,
+                   help="1 = read all feature h5s into host RAM at startup "
+                        "(removes per-batch disk IO; needs dataset-sized RAM)")
 
 
 def _add_model_args(p: argparse.ArgumentParser) -> None:

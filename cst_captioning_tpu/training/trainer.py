@@ -89,9 +89,11 @@ class Trainer:
         train_paths = _split_paths(opt, "train")
         if train_paths is None:
             raise ValueError("train split paths are required")
-        self.train_ds = CaptionDataset(train_paths)
+        preload = bool(getattr(opt, "preload_feats", 0))
+        self.train_ds = CaptionDataset(train_paths, preload=preload)
         val_paths = _split_paths(opt, "val")
-        self.val_ds = CaptionDataset(val_paths) if val_paths else None
+        self.val_ds = (CaptionDataset(val_paths, preload=preload)
+                       if val_paths else None)
         self.vocab = self.train_ds.vocab
 
         consensus_weights = None

@@ -127,6 +127,18 @@ class TestLoader:
         for i in range(4):
             assert b.weights[i * 5 : (i + 1) * 5].mean() == pytest.approx(1.0, abs=1e-5)
 
+    def test_preload_matches_lazy(self, synth):
+        from cst_captioning_tpu.data.synthetic import split_paths as sp
+
+        lazy = CaptionDataset(sp(synth))
+        hot = CaptionDataset(sp(synth), preload=True)
+        ix = np.array([3, 0, 3, 5])
+        for a, b in zip(lazy.features(ix), hot.features(ix)):
+            np.testing.assert_array_equal(a, b)
+        assert hot.feat_dims == lazy.feat_dims
+        hot.close()  # no-op file list; must not raise
+        lazy.close()
+
     def test_gts_for_reward(self, ds):
         loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, include_gts=True)
         b = loader.next_batch()

@@ -73,6 +73,45 @@ class TestBF16:
                                    np.asarray(ref_ctx), rtol=5e-2, atol=5e-2)
 
 
+class TestBF16Parity:
+    def test_bf16_model_logits_match_across_flag(self):
+        labels = jnp.array([[3, 4, 5, 0, 0, 0], [6, 7, 0, 0, 0, 0]])
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))]
+        kw = dict(vocab_size=12, embed_size=16, hidden_size=16,
+                  attn_size=16, dropout_rate=0.0, dtype=jnp.bfloat16)
+        plain = CaptionModel(**kw)
+        fused = CaptionModel(**kw, use_pallas_attention=True)
+        variables = plain.init(jax.random.PRNGKey(0), feats, labels)
+        a = plain.apply(variables, feats, labels).astype(jnp.float32)
+        b = fused.apply(variables, feats, labels).astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_bf16_grads_finite_and_close(self):
+        k = jax.random.PRNGKey(3)
+        q, pm, mem = (jax.random.normal(jax.random.fold_in(k, i),
+                                        s).astype(jnp.bfloat16)
+                      for i, s in enumerate([(B, A), (B, T, A), (B, T, H)]))
+        v = jax.random.normal(jax.random.fold_in(k, 3), (A,))
+
+        def loss_pallas(q, pm, mem, v):
+            ctx, _ = fused_additive_attention(q, pm, mem, v, 2, True)
+            return jnp.sum(ctx.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, pm, mem, v):
+            ctx, _ = reference(q.astype(jnp.float32), pm.astype(jnp.float32),
+                               mem.astype(jnp.float32), v)
+            return jnp.sum(ctx ** 2)
+
+        g_p = jax.grad(loss_pallas, argnums=(1, 3))(q, pm, mem, v)
+        g_r = jax.grad(loss_ref, argnums=(1, 3))(q, pm, mem, v)
+        for a, b in zip(g_p, g_r):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            assert np.isfinite(a).all()
+            np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-2)
+
+
 class TestGradients:
     def test_vjp_matches_reference_grads(self, inputs):
         target = jax.random.normal(jax.random.PRNGKey(9), (B, H))
