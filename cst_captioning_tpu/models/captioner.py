@@ -55,10 +55,12 @@ class CaptionModel(nn.Module):
                                     # must cover the label seq_length
     dtype: jnp.dtype = jnp.float32
     use_pallas_attention: bool = False  # fused VMEM attention kernel (lstm)
+    fusion_type: str = "temporal"   # "temporal" | "modality" (manet variant)
 
     def setup(self):
         self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
-                                      self.dtype, name="encoder")
+                                      self.dtype, fusion=self.fusion_type,
+                                      name="encoder")
         if self.decoder_type == "lstm":
             self.memory_proj = nn.Dense(self.attn_size, use_bias=False,
                                         dtype=self.dtype, name="memory_proj")

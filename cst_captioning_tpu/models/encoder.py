@@ -12,7 +12,12 @@ modalities.  Rebuilt TPU-first:
   into an attention memory (B, sum_m T_m, H) for the attention-LSTM and
   Transformer decoders, which the reference's mean-pool destroyed — this is
   the "attention-LSTM decoder" of the north-star and the path that scales
-  to ActivityNet-length feature streams (SURVEY.md §5 long-context).
+  to ActivityNet-length feature streams (SURVEY.md §5 long-context);
+- ``fusion="modality"`` instead exposes the per-modality pooled embeddings
+  as an (B, M, H) memory so the decoder's attention runs over *modalities*
+  — the reference's modality-attention variant ("manet" per SURVEY.md §2
+  "Captioning model", selected there via --model_type) restated on the
+  same attention plumbing.
 """
 
 from __future__ import annotations
@@ -34,6 +39,7 @@ class FeatureEncoder(nn.Module):
     hidden_size: int
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    fusion: str = "temporal"   # "temporal" | "modality" (manet-style)
 
     @nn.compact
     def __call__(self, feats: Sequence[jnp.ndarray], train: bool = False):
@@ -49,7 +55,12 @@ class FeatureEncoder(nn.Module):
             h = nn.relu(h)
             projected.append(h)                    # (B, T_m, H)
             pooled.append(jnp.mean(h, axis=1))     # (B, H)
-        memory = jnp.concatenate(projected, axis=1)
+        if self.fusion == "modality":
+            memory = jnp.stack(pooled, axis=1)     # (B, M, H) modality tokens
+        elif self.fusion == "temporal":
+            memory = jnp.concatenate(projected, axis=1)
+        else:
+            raise ValueError(f"unknown fusion {self.fusion!r}")
         fused = jnp.concatenate(pooled, axis=-1)
         fused = nn.Dense(self.hidden_size, dtype=self.dtype, name="fuse")(fused)
         fused = nn.tanh(fused)

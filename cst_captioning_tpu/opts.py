@@ -46,6 +46,11 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--model_type", default="lstm",
                    choices=("lstm", "transformer"),
                    help="decoder family (transformer = driver config 5)")
+    g.add_argument("--fusion_type", default="temporal",
+                   choices=("temporal", "manet"),
+                   help="attention memory: temporal frames (default) or "
+                        "per-modality tokens (the reference's modality-"
+                        "attention 'manet' variant)")
     g.add_argument("--rnn_size", type=int, default=512,
                    help="LSTM hidden size / transformer model dim")
     g.add_argument("--input_encoding_size", type=int, default=512,

@@ -59,6 +59,8 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
         tx_max_len=max(seq_length + 1, opt.max_length + 1),
         dtype=jnp.bfloat16 if opt.use_bfloat16 else jnp.float32,
         use_pallas_attention=bool(getattr(opt, "pallas_attention", 0)),
+        fusion_type={"manet": "modality"}.get(
+            getattr(opt, "fusion_type", "temporal"), "temporal"),
     )
 
 

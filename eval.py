@@ -41,7 +41,7 @@ def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
             k: saved[k] for k in (
                 "model_type", "rnn_size", "input_encoding_size", "num_layers",
                 "att_size", "use_attention", "drop_prob", "num_heads",
-                "num_tx_layers", "use_bfloat16", "max_length",
+                "num_tx_layers", "use_bfloat16", "max_length", "fusion_type",
             ) if k in saved
         }})
     else:

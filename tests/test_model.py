@@ -23,12 +23,15 @@ def make_model(**kw):
     return CaptionModel(**defaults)
 
 
-@pytest.fixture(scope="module", params=["lstm", "lstm_noattn", "transformer"])
+@pytest.fixture(scope="module",
+                params=["lstm", "lstm_noattn", "lstm_manet", "transformer"])
 def model_and_vars(request):
     kind = request.param
     kw = {}
     if kind == "lstm_noattn":
         kw = {"use_attention": False}
+    elif kind == "lstm_manet":
+        kw = {"fusion_type": "modality"}  # attention over modality tokens
     elif kind == "transformer":
         kw = {"decoder_type": "transformer", "num_heads": 2, "num_tx_layers": 2}
     model = make_model(**kw)

@@ -167,6 +167,16 @@ def test_transformer_decoder_stage(data, tmp_path_factory):
     assert rc == 0
 
 
+def test_manet_fusion_stage(data, tmp_path_factory):
+    """Modality-attention ('manet') variant through the CLI surface."""
+    out = str(tmp_path_factory.mktemp("manet"))
+    res = run_stage(
+        data, os.path.join(out, "manet_xe"),
+        **{"--fusion_type": ["manet"], "--max_epochs": ["1"]},
+    )
+    assert res["best_score"] is not None
+
+
 def test_scb_sample_stage(data, tmp_path_factory):
     out = str(tmp_path_factory.mktemp("scb"))
     res = run_stage(
