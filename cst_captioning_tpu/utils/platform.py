@@ -1,0 +1,59 @@
+"""Host-platform control: keep jax off a wedged remote-TPU tunnel.
+
+This session's interpreter may boot with an ``.axon_site`` sitecustomize
+(injected via PYTHONPATH) that imports jax and registers a remote-TPU
+"axon" PJRT plugin whose tunnel client blocks indefinitely when the tunnel
+is down.  Setting ``JAX_PLATFORMS`` in-process is then too late — jax read
+the env at import — so CPU-only code paths (tests, the multichip dry run)
+must both update jax's config directly and deregister the plugin factory
+so ``jax.devices()`` can never initialize the tunnel client.
+
+Single source of truth for that scrub; used by tests/conftest.py and
+``__graft_entry__._dryrun_multichip_impl``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def axon_registered() -> bool:
+    """True if the remote-TPU "axon" PJRT plugin factory is registered.
+
+    Fails CLOSED: if jax's private registry moved and we cannot tell, fall
+    back to whether the ``.axon_site`` sitecustomize is on PYTHONPATH —
+    callers use this to decide whether touching the default backend could
+    hang, so "unsure" must not disarm their guard.
+    """
+    try:
+        import jax._src.xla_bridge as _xb
+
+        return "axon" in _xb._backend_factories
+    except Exception:  # pragma: no cover - jax internals moved
+        return "axon" in os.environ.get("PYTHONPATH", "").lower()
+
+
+def scrub_env(env: dict) -> dict:
+    """Strip everything that could route jax through the axon tunnel."""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    return env
+
+
+def force_cpu_platform() -> None:
+    """Pin jax to the host-CPU platform and drop the axon plugin factory.
+
+    Safe to call whether or not jax is already imported; env vars are also
+    set so subprocesses inherit the choice.
+    """
+    scrub_env(os.environ)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax internals moved; config above still holds
+        pass

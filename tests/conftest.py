@@ -15,24 +15,21 @@ routes every test compile through the wire even when it is up).
 """
 
 import os
+import sys
 
-# Env vars still matter for any subprocess the tests spawn.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# XLA_FLAGS must be set before jax initializes the cpu client.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
     os.environ["XLA_FLAGS"] = flags
 
+from cst_captioning_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:  # deregister the axon remote-TPU plugin if sitecustomize installed it
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - jax internals moved; cpu config above still holds
-    pass
 
 assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual CPU mesh, got " + repr(jax.devices())
