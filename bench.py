@@ -16,24 +16,36 @@ IO in real training; see cst_captioning_tpu/data/loader.py).
 
 Flags: --stage xe|cst benches the XE step or the full CST iteration
 (rollout + host CIDEr-D reward + REINFORCE grad step).
+
+Backend robustness: the default jax backend in this environment can be a
+remote-TPU PJRT plugin whose tunnel client blocks forever when the tunnel
+is down (round 1's driver bench died exactly there, rc=1/hang).  main()
+therefore first PROBES the default backend in a subprocess with a timeout
+(+retries), then runs the measurement in a child process — on the probed
+device backend if it answered, else on the host CPU with a scrubbed
+environment.  The JSON line always reports which platform actually ran
+(``platform`` key) so a CPU fallback can't masquerade as a TPU number.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
-
-import jax
-import jax.numpy as jnp
 
 BASELINE_CAPTIONS_PER_SEC = 5000.0
 
 
 def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
           hidden: int, use_bfloat16: bool):
+    import jax
+    import jax.numpy as jnp
+
     from cst_captioning_tpu.models import CaptionModel
     from cst_captioning_tpu.training.state import create_train_state, make_optimizer
 
@@ -65,6 +77,9 @@ def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
 
 
 def bench_xe(args):
+    import jax
+    import jax.numpy as jnp
+
     from cst_captioning_tpu.training.steps import make_xe_step
 
     model, state, feats, labels = build(
@@ -86,6 +101,9 @@ def bench_xe(args):
 
 
 def bench_cst(args):
+    import jax
+    import jax.numpy as jnp
+
     from cst_captioning_tpu.data.vocab import Vocab
     from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
     from cst_captioning_tpu.training.rewards import RewardComputer
@@ -133,7 +151,7 @@ def bench_cst(args):
     return args.batch_size * args.seq_per_img * args.steps / dt
 
 
-def main():
+def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--stage", default="xe", choices=("xe", "cst"))
     p.add_argument("--batch_size", type=int, default=32)
@@ -143,7 +161,20 @@ def main():
     p.add_argument("--hidden", type=int, default=512)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--bfloat16", type=int, default=1)
-    args = p.parse_args()
+    p.add_argument("--platform", default="auto", choices=("auto", "device", "cpu"),
+                   help="auto: probe the default backend, fall back to cpu; "
+                        "device: require the probed backend; cpu: host only")
+    p.add_argument("--probe_timeout", type=float, default=120.0,
+                   help="seconds before one backend-init probe is declared wedged")
+    p.add_argument("--probe_retries", type=int, default=2)
+    p.add_argument("--child_timeout", type=float, default=1800.0,
+                   help="seconds for the measurement child process")
+    return p.parse_args()
+
+
+def run_measurement(args) -> None:
+    """Measure in THIS process (assumes a live jax backend) and print JSON."""
+    import jax
 
     cps = bench_xe(args) if args.stage == "xe" else bench_cst(args)
     # The benched step runs under plain jax.jit on ONE device, so the
@@ -155,7 +186,109 @@ def main():
         "value": round(per_chip, 1),
         "unit": "captions/s/chip",
         "vs_baseline": round(per_chip / BASELINE_CAPTIONS_PER_SEC, 3),
+        "platform": jax.devices()[0].platform,
+        "num_devices": jax.device_count(),
     }))
+
+
+def probe_backend(timeout_s: float, retries: int) -> str | None:
+    """Initialize the default jax backend in a throwaway subprocess.
+
+    Returns its platform string, or None if every attempt failed or timed
+    out — a downed remote-TPU tunnel blocks *inside* backend init, so the
+    probe (not the measurement) is what must absorb the hang.
+
+    The probe child runs in its own process group with output to temp
+    files, not pipes: a wedged PJRT plugin can spawn helper processes that
+    inherit captured pipes and would keep them open past the child's own
+    kill, turning ``subprocess.run(capture_output=True)``'s post-timeout
+    drain into a second, unbounded hang.
+    """
+    import signal
+    import tempfile
+
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    for attempt in range(retries + 1):
+        with tempfile.TemporaryFile("w+") as out, \
+                tempfile.TemporaryFile("w+") as err:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=out, stderr=err, text=True, start_new_session=True,
+            )
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    proc.kill()
+                proc.wait()
+                print(f"bench: backend probe timed out ({timeout_s:.0f}s), "
+                      f"attempt {attempt + 1}/{retries + 1}", file=sys.stderr)
+                continue
+            out.seek(0)
+            for line in out.read().splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1].strip()
+            err.seek(0)
+            print(f"bench: backend probe rc={proc.returncode}, attempt "
+                  f"{attempt + 1}/{retries + 1}\n{err.read()[-2000:]}",
+                  file=sys.stderr)
+    return None
+
+
+def spawn_child(scrub: bool, timeout_s: float) -> int:
+    """Re-exec this script for the measurement; returns the child's rc."""
+    from cst_captioning_tpu.utils.platform import scrub_env
+
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    if scrub:
+        scrub_env(env)
+        env["PYTHONPATH"] = ""  # drop any sitecustomize (e.g. .axon_site)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: measurement child timed out ({timeout_s:.0f}s)",
+              file=sys.stderr)
+        return 124
+    return proc.returncode
+
+
+def main():
+    args = parse_args()
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        run_measurement(args)
+        return
+
+    use_device = False
+    if args.platform in ("auto", "device"):
+        plat = probe_backend(args.probe_timeout, args.probe_retries)
+        if plat is not None and plat != "cpu":
+            use_device = True
+        elif args.platform == "device":
+            sys.exit("bench: --platform device but the default backend is "
+                     f"{plat!r} after {args.probe_retries + 1} probes")
+        elif plat == "cpu":
+            print("bench: default backend is the host CPU; measuring there",
+                  file=sys.stderr)
+        else:
+            print("bench: default backend unreachable, falling back to host "
+                  "CPU (JSON will say platform=cpu)", file=sys.stderr)
+
+    rc = spawn_child(scrub=not use_device, timeout_s=args.child_timeout)
+    if rc != 0 and use_device and args.platform == "auto":
+        # Device path died mid-measurement (tunnel dropped?) — still emit a
+        # well-formed JSON line rather than nothing.
+        print("bench: device measurement failed, retrying on host CPU",
+              file=sys.stderr)
+        rc = spawn_child(scrub=True, timeout_s=args.child_timeout)
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

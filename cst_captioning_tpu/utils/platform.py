@@ -40,6 +40,17 @@ def scrub_env(env: dict) -> dict:
     return env
 
 
+def with_host_device_count(flags: str, n: int) -> str:
+    """XLA_FLAGS string with ``--xla_force_host_platform_device_count=n``,
+    preserving every other flag already present."""
+    kept = [
+        f for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(kept)
+
+
 def force_cpu_platform() -> None:
     """Pin jax to the host-CPU platform and drop the axon plugin factory.
 
