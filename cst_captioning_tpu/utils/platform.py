@@ -51,6 +51,29 @@ def with_host_device_count(flags: str, n: int) -> str:
     return " ".join(kept)
 
 
+def run_in_group(cmd: list, *, env: dict, cwd: str | None = None,
+                 timeout: float | None = None) -> int:
+    """Run ``cmd`` in its own process GROUP with inherited stdio.
+
+    On timeout, SIGKILL the whole group — a wedged PJRT tunnel plugin can
+    spawn helper processes that outlive a direct-child kill — and return
+    124 (the coreutils ``timeout`` convention).  Otherwise return the rc.
+    """
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, env=env, cwd=cwd, start_new_session=True)
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait()
+        return 124
+
+
 def force_cpu_platform() -> None:
     """Pin jax to the host-CPU platform and drop the axon plugin factory.
 
