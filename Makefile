@@ -84,7 +84,7 @@ anet_xe:
 	  --checkpoint_path $(OUT)/$(EXP)_anet_xe
 
 bench:
-	$(PY) bench.py --stage xe
+	$(PY) bench.py
 
 # -- zero-setup synthetic demo --------------------------------------------
 

@@ -1,8 +1,15 @@
 #!/usr/bin/env python
-"""Throughput benchmark — captions/sec/chip on the XE train step.
+"""Throughput benchmark — captions/sec/chip, XE and CST train stages.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "captions/s/chip", "vs_baseline": N}
+
+By default BOTH stages are measured and the headline value is the MIN of
+the two, so the artifact can't pass on the easy stage alone (--stage xe or
+cst isolates one).  The CST stage runs the shipped trainer configuration:
+native C++ CIDEr-D reward scorer and the overlapped reward pipeline
+(--overlap_depth = trainer's --overlap_rewards default); the strictly
+serial reference-semantics loop is also measured and reported.
 
 Baseline: the driver north-star of >= 5000 captions/sec/chip for the XE and
 CST stages on MSR-VTT-shaped data (BASELINE.md; the reference published no
@@ -13,9 +20,6 @@ features, vocab ~8k, 30-token captions, 20 captions/video, attention-LSTM
 decoder (hidden 512).  Data is synthetic and device-resident so the number
 measures the compiled step, not disk IO (the loader's prefetch thread hides
 IO in real training; see cst_captioning_tpu/data/loader.py).
-
-Flags: --stage xe|cst benches the XE step or the full CST iteration
-(rollout + host CIDEr-D reward + REINFORCE grad step).
 
 Backend robustness: the default jax backend in this environment can be a
 remote-TPU PJRT plugin whose tunnel client blocks forever when the tunnel
@@ -101,13 +105,22 @@ def bench_xe(args):
 
 
 def bench_cst(args):
+    """Full CST iteration throughput in the SHIPPED trainer configuration:
+    C++ CIDEr-D reward scorer (the trainer default; --native_cider 0 for
+    the pure-Python one) and the overlapped reward pipeline
+    (--overlap_depth, default = the trainer's --overlap_rewards default).
+    Also measures the serial (reference-semantics) loop for the report.
+    """
     import jax
-    import jax.numpy as jnp
 
     from cst_captioning_tpu.data.vocab import Vocab
-    from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+    from cst_captioning_tpu.opts import DEFAULT_OVERLAP_REWARDS
+    from cst_captioning_tpu.training.pipeline import RewardPipeline
     from cst_captioning_tpu.training.rewards import RewardComputer
-    from cst_captioning_tpu.training.steps import make_rl_grad_step, make_rollout
+    from cst_captioning_tpu.training.steps import (
+        make_rl_grad_step,
+        make_rollout_fused,
+    )
 
     model, state, feats, labels = build(
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
@@ -123,37 +136,72 @@ def bench_cst(args):
         ]
         for i in range(args.batch_size)
     }
-    df, n = build_corpus_df(refs)
-    scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    scorer = None
+    scorer_kind = "python"
+    if args.native_cider:
+        try:
+            from cst_captioning_tpu.native import NativeCiderD
+
+            scorer = NativeCiderD(refs, vocab.word_to_ix)
+            scorer_kind = "native"
+        except Exception as e:
+            print(f"bench: native CIDEr-D unavailable ({e}); using Python",
+                  file=sys.stderr)
+    if scorer is None:
+        from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+
+        df, n = build_corpus_df(refs)
+        scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
     rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
                         baseline="greedy")
     video_ids = list(refs.keys())
+    ncaps = args.batch_size * args.seq_per_img
 
-    rollout = jax.jit(make_rollout(model, args.seq_len, args.seq_per_img))
+    rollout = jax.jit(make_rollout_fused(model, args.seq_len, args.seq_per_img))
     rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
                       donate_argnums=(0,))
+    depth = (args.overlap_depth if args.overlap_depth is not None
+             else DEFAULT_OVERLAP_REWARDS)
 
-    def one_iter(state, key):
-        sampled, greedy = rollout(state.params, feats, key)
-        s = np.asarray(jax.device_get(sampled))
-        g = np.asarray(jax.device_get(greedy))
-        adv, _ = rc(video_ids, s, g)
-        state, m = rl_step(state, feats, sampled, jnp.asarray(adv), key)
-        return state, m
+    def run_loop(state, depth, steps, key0):
+        # The EXACT shipped pipeline: bench and trainer drive the same class.
+        pipe = RewardPipeline(
+            rollout, rl_step,
+            lambda ctx, s, g: rc(ctx, s, g), depth,
+        )
+        last = None
+        for i in range(steps):
+            key = jax.random.PRNGKey(key0 + i)
+            state, done = pipe.push(state, feats, key, key, video_ids)
+            if done:
+                last = done[-1]
+        state, done = pipe.drain(state)
+        if done:
+            last = done[-1]
+        jax.block_until_ready(last[1]["loss"])
+        return state
 
-    state, m = one_iter(state, jax.random.PRNGKey(0))          # compile
-    jax.block_until_ready(m["loss"])
+    state = run_loop(state, depth, 2, 0)                       # compile/warm
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, m = one_iter(state, jax.random.PRNGKey(i + 1))
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    return args.batch_size * args.seq_per_img * args.steps / dt
+    state = run_loop(state, depth, args.steps, 100)
+    overlapped = ncaps * args.steps / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    state = run_loop(state, 0, args.steps, 200)
+    serial = ncaps * args.steps / (time.perf_counter() - t0)
+    return {
+        "value": overlapped,
+        "serial_captions_per_sec": round(serial, 1),
+        "overlap_depth": depth,
+        "scorer": scorer_kind,
+    }
 
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--stage", default="xe", choices=("xe", "cst"))
+    p.add_argument("--stage", default="both", choices=("both", "xe", "cst"),
+                   help="'both' (default) measures XE and CST and reports "
+                        "the MIN as the headline value — the driver artifact "
+                        "cannot pass on the easy stage alone")
     p.add_argument("--batch_size", type=int, default=32)
     p.add_argument("--seq_per_img", type=int, default=20)
     p.add_argument("--seq_len", type=int, default=30)
@@ -161,6 +209,12 @@ def parse_args():
     p.add_argument("--hidden", type=int, default=512)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--bfloat16", type=int, default=1)
+    p.add_argument("--overlap_depth", type=int, default=None,
+                   help="CST reward-pipeline depth; default = the trainer's "
+                        "--overlap_rewards default (read from opts.py); 0 "
+                        "benches the strictly serial reference semantics")
+    p.add_argument("--native_cider", type=int, default=1,
+                   help="1 = C++ reward scorer (trainer default)")
     p.add_argument("--platform", default="auto", choices=("auto", "device", "cpu"),
                    help="auto: probe the default backend, fall back to cpu; "
                         "device: require the probed backend; cpu: host only")
@@ -173,21 +227,53 @@ def parse_args():
 
 
 def run_measurement(args) -> None:
-    """Measure in THIS process (assumes a live jax backend) and print JSON."""
+    """Measure in THIS process (assumes a live jax backend) and print JSON.
+
+    The benched steps run under plain jax.jit on ONE device, so the
+    measured throughput already is per-chip — DP scales it linearly
+    (tests/test_parallel.py proves step equivalence across the mesh).
+    """
     import jax
 
-    cps = bench_xe(args) if args.stage == "xe" else bench_cst(args)
-    # The benched step runs under plain jax.jit on ONE device, so the
-    # measured throughput already is per-chip — DP scales it linearly
-    # (tests/test_parallel.py proves step equivalence across the mesh).
-    per_chip = cps
-    print(json.dumps({
-        "metric": f"{args.stage}_captions_per_sec_per_chip",
-        "value": round(per_chip, 1),
+    common = {
         "unit": "captions/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_CAPTIONS_PER_SEC, 3),
         "platform": jax.devices()[0].platform,
         "num_devices": jax.device_count(),
+    }
+    if args.stage == "xe":
+        xe = bench_xe(args)
+        print(json.dumps({
+            "metric": "xe_captions_per_sec_per_chip",
+            "value": round(xe, 1),
+            "vs_baseline": round(xe / BASELINE_CAPTIONS_PER_SEC, 3),
+            **common,
+        }))
+        return
+    if args.stage == "cst":
+        cst = bench_cst(args)
+        print(json.dumps({
+            "metric": "cst_captions_per_sec_per_chip",
+            "value": round(cst["value"], 1),
+            "vs_baseline": round(cst["value"] / BASELINE_CAPTIONS_PER_SEC, 3),
+            **common,
+            **{k: v for k, v in cst.items() if k != "value"},
+        }))
+        return
+    # default: BOTH stages, headline = the worse of the two, so the driver
+    # artifact can never pass on the easy stage alone (VERDICT.md round 2).
+    xe = bench_xe(args)
+    cst = bench_cst(args)
+    worst = min(xe, cst["value"])
+    print(json.dumps({
+        "metric": "min_xe_cst_captions_per_sec_per_chip",
+        "value": round(worst, 1),
+        "vs_baseline": round(worst / BASELINE_CAPTIONS_PER_SEC, 3),
+        **common,
+        "xe_captions_per_sec": round(xe, 1),
+        "cst_captions_per_sec": round(cst["value"], 1),
+        "cst_serial_captions_per_sec": cst["serial_captions_per_sec"],
+        "cst_overlap_depth": cst["overlap_depth"],
+        "cst_scorer": cst["scorer"],
     }))
 
 

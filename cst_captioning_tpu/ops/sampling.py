@@ -69,25 +69,34 @@ def sample_tokens(
     batch: int,
     max_len: int,
     rng: jax.Array,
-    greedy: bool = False,
+    greedy=False,
     temperature: float = 1.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Roll out ``max_len`` steps from BOS (=0).
 
+    ``greedy`` is either a python bool (whole batch) or a per-row (N,) bool
+    array — the latter lets one scan carry multinomial rollout rows and
+    greedy baseline rows together (``sample_with_baseline``).
+
     Returns (tokens (N, L) int32 0-terminated, logprobs (N, L) float32 of
     the emitted tokens, 0 past the first EOS).
     """
+    per_row = not isinstance(greedy, bool)
 
     def body(state, key):
         carry, prev, finished = state
         carry, logits = step(carry, prev)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        if greedy:
+        if greedy is True:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             nxt = jax.random.categorical(
                 key, logits / jnp.maximum(temperature, 1e-6), axis=-1
             ).astype(jnp.int32)
+            if per_row:
+                nxt = jnp.where(
+                    greedy, jnp.argmax(logits, axis=-1).astype(jnp.int32), nxt
+                )
         tok_logp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
         emit = jnp.where(finished, 0, nxt)
         emit_logp = jnp.where(finished, 0.0, tok_logp)
@@ -133,6 +142,44 @@ def sample_captions(
     step = make_decode_step(model, variables, memory, proj_mem, pooled)
     return sample_tokens(step, carry, n, max_len, rng,
                          greedy=greedy, temperature=temperature)
+
+
+def sample_with_baseline(
+    model,
+    variables,
+    feats: Sequence[jnp.ndarray],
+    rng: jax.Array,
+    max_len: int,
+    seq_per_img: int,
+    temperature: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Multinomial rollout + greedy SCST baseline in ONE fused scan.
+
+    The CST iteration needs both the (B*S) policy samples and the (B)
+    greedy baseline decodes.  Two sequential scans pay the scan's
+    per-step latency twice (the per-step matmuls are tiny, so the rollout
+    is latency- not FLOP-bound on TPU); concatenating the greedy rows onto
+    the sampled rows and flag-selecting argmax per row halves it.
+
+    -> (sampled (B*S, L), sampled_logprobs (B*S, L), greedy (B, L)).
+    """
+    memory, proj_mem, pooled = model.apply(variables, feats, method="encode")
+    b = pooled.shape[0]
+    ns = b * seq_per_img
+    memory = jnp.concatenate(
+        [repeat_for_captions(memory, seq_per_img), memory], axis=0)
+    proj_mem = jnp.concatenate(
+        [repeat_for_captions(proj_mem, seq_per_img), proj_mem], axis=0)
+    pooled = jnp.concatenate(
+        [repeat_for_captions(pooled, seq_per_img), pooled], axis=0)
+    carry = model.apply(variables, pooled, max_len, method="init_carry")
+    step = make_decode_step(model, variables, memory, proj_mem, pooled)
+    greedy_rows = jnp.arange(ns + b) >= ns
+    tokens, logprobs = sample_tokens(
+        step, carry, ns + b, max_len, rng,
+        greedy=greedy_rows, temperature=temperature,
+    )
+    return tokens[:ns], logprobs[:ns], tokens[ns:]
 
 
 def greedy_decode(model, variables, feats, max_len: int) -> jnp.ndarray:

@@ -87,6 +87,11 @@ def _add_optim_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--seed", type=int, default=123)
 
 
+# Default CST reward-pipeline depth (--overlap_rewards).  bench.py reads
+# this so bare `python bench.py` always measures the shipped configuration.
+DEFAULT_OVERLAP_REWARDS = 1
+
+
 def _add_cst_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("CST / REINFORCE")
     g.add_argument("--use_rl", type=int, default=0,
@@ -100,6 +105,14 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                         "0 = all")
     g.add_argument("--temperature", type=float, default=1.0,
                    help="multinomial sampling temperature")
+    g.add_argument("--overlap_rewards", type=int,
+                   default=DEFAULT_OVERLAP_REWARDS,
+                   help="CST pipeline depth: number of rollouts kept in "
+                        "flight while the host scores rewards.  0 = strict "
+                        "reference semantics (rollout -> reward -> grad "
+                        "serially); k >= 1 overlaps the reward of step t "
+                        "with rollouts t+1..t+k, making samples up to k "
+                        "updates stale for the grad step (PARITY.md)")
     g.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ CIDEr-D reward scorer (token-id fast path);"
                         " 0 = pure-Python scorer honoring --train_cached_tokens")

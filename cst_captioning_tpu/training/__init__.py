@@ -8,7 +8,12 @@ deliberately kept *outside* jit (SURVEY.md §7 hard part (a)).
 """
 
 from .state import create_train_state, make_optimizer
-from .steps import make_rl_grad_step, make_rollout, make_xe_step
+from .steps import (
+    make_rl_grad_step,
+    make_rollout,
+    make_rollout_fused,
+    make_xe_step,
+)
 from .rewards import RewardComputer, decode_sequences
 from .checkpoint import CheckpointManager
 from .evaluation import eval_split
@@ -24,5 +29,6 @@ __all__ = [
     "make_optimizer",
     "make_rl_grad_step",
     "make_rollout",
+    "make_rollout_fused",
     "make_xe_step",
 ]

@@ -1,0 +1,100 @@
+"""Overlapped CST reward pipeline — ONE implementation, shared.
+
+The CST iteration is two device programs with a host gap: rollout ->
+host CIDEr-D advantage -> grad step (SURVEY.md §3.2).  Run serially, the
+device idles through the host work plus (on remote-TPU tunnels) a full
+round trip per transfer.  ``RewardPipeline`` keeps up to ``depth`` rollouts
+in flight: the reward of step t is computed while the device already runs
+rollouts t+1..t+depth, so steady-state step time is the device time alone.
+
+Semantics: depth 0 reproduces the reference's strictly serial loop; depth
+k >= 1 grades each sample under params up to k updates newer than the ones
+that drew it (stale-sample REINFORCE; decision + measurements in
+PARITY.md).  ``drain()`` flushes the queue so checkpoints/validation always
+see fully-updated params.
+
+Both ``training.trainer.Trainer`` and the root ``bench.py`` drive THIS
+class, so the benchmark cannot drift from the shipped trainer semantics
+(VERDICT.md round 2, next-round item 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+
+class RewardPipeline:
+    """k-deep rollout -> host advantage -> grad-step pipeline.
+
+    Args:
+      rollout_fn: ``(params, feats, rng) -> (sampled, fetch)`` device
+        program (``steps.make_rollout_fused``): ``sampled`` stays on device
+        for the grad step, ``fetch`` is the single host-bound array —
+        ``concat([sampled, greedy])`` rows under the greedy baseline, just
+        the sampled rows otherwise.
+      rl_step_fn: ``(state, feats, sampled, advantage, rng) ->
+        (state, metrics)`` device program (``steps.make_rl_grad_step``).
+      advantage_fn: host callback ``(ctx, sampled_rows, greedy_rows|None)
+        -> (advantage (N,), stats dict)`` — the RewardComputer call; ``ctx``
+        is whatever per-batch payload it needs (video ids).
+      depth: rollouts kept in flight (``--overlap_rewards``); 0 = serial.
+    """
+
+    def __init__(
+        self,
+        rollout_fn: Callable,
+        rl_step_fn: Callable,
+        advantage_fn: Callable,
+        depth: int,
+    ):
+        self.rollout_fn = rollout_fn
+        self.rl_step_fn = rl_step_fn
+        self.advantage_fn = advantage_fn
+        self.depth = max(0, int(depth))
+        self._pending: List[Tuple] = []
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def push(self, state, feats, roll_rng, step_rng, ctx: Any
+             ) -> Tuple[Any, List[Tuple[Any, Dict[str, float]]]]:
+        """Dispatch one rollout; complete the oldest step once more than
+        ``depth`` are in flight.  Returns the (possibly updated) state and
+        the list of steps completed by this call as ``(ctx, metrics)``
+        pairs — empty while the pipeline fills, one entry at steady state.
+        Callers attribute metrics to the completing step's own ctx (e.g.
+        its step index) so logs stay honest under the pipeline lag."""
+        sampled, fetch = self.rollout_fn(state.params, feats, roll_rng)
+        try:  # start the device->host copy early; np.asarray later reaps it
+            fetch.copy_to_host_async()
+        except AttributeError:  # backend without async host copies
+            pass
+        self._pending.append((sampled, fetch, feats, step_rng, ctx))
+        if len(self._pending) > self.depth:
+            state, done = self._complete_one(state)
+            return state, [done]
+        return state, []
+
+    def _complete_one(self, state) -> Tuple[Any, Tuple[Any, Dict[str, float]]]:
+        sampled, fetch, feats, step_rng, ctx = self._pending.pop(0)
+        fetched = np.asarray(jax.device_get(fetch))
+        n = sampled.shape[0]
+        greedy_rows = fetched[n:] if fetched.shape[0] > n else None
+        advantage, stats = self.advantage_fn(ctx, fetched[:n], greedy_rows)
+        state, metrics = self.rl_step_fn(
+            state, feats, sampled, advantage, step_rng
+        )
+        metrics = dict(metrics)
+        metrics.update(stats)
+        return state, (ctx, metrics)
+
+    def drain(self, state) -> Tuple[Any, List[Tuple[Any, Dict[str, float]]]]:
+        """Flush all in-flight steps (epoch boundary / checkpoint / end)."""
+        completed: List[Tuple[Any, Dict[str, float]]] = []
+        while self._pending:
+            state, done = self._complete_one(state)
+            completed.append(done)
+        return state, completed

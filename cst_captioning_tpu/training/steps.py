@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from ..ops.losses import cross_entropy_loss, reward_loss, sequence_mask, token_logprobs
-from ..ops.sampling import sample_captions
+from ..ops.sampling import sample_captions, sample_with_baseline
 from .state import TrainState
 
 
@@ -58,29 +58,62 @@ def make_rollout(model, max_len: int, seq_per_img: int,
                  temperature: float = 1.0, greedy_baseline: bool = True) -> Callable:
     """(params, feats, rng) -> (sampled (B*S, L), greedy (B, L)).
 
-    One device program: multinomial rollout for the policy sample plus the
-    greedy argmax decode used by the SCST baseline.  When the baseline is
-    SCB the greedy decode is dead code XLA never executes — still traced,
-    so one compilation covers both baselines; pass ``greedy_baseline=False``
-    to skip the second scan entirely (smaller program for pure-SCB runs).
+    One device program, ONE scan: the greedy baseline rows ride the same
+    scan as the multinomial rollout rows (``sample_with_baseline``) — the
+    per-step matmuls are too small to hide a second scan's sequential
+    latency on TPU.  Pass ``greedy_baseline=False`` for pure-SCB runs to
+    drop the baseline rows entirely (greedy output is then all-zeros).
     """
 
     def rollout(params, feats, rng):
         variables = {"params": params}
-        sampled, _ = sample_captions(
-            model, variables, feats, rng, max_len,
-            seq_per_img=seq_per_img, greedy=False, temperature=temperature,
-        )
         if greedy_baseline:
-            greedy_toks, _ = sample_captions(
+            sampled, _, greedy_toks = sample_with_baseline(
                 model, variables, feats, rng, max_len,
-                seq_per_img=1, greedy=True,
+                seq_per_img=seq_per_img, temperature=temperature,
             )
         else:
+            sampled, _ = sample_captions(
+                model, variables, feats, rng, max_len,
+                seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+            )
             greedy_toks = jnp.zeros(
                 (feats[0].shape[0], max_len), dtype=jnp.int32
             )
         return sampled, greedy_toks
+
+    return rollout
+
+
+def make_rollout_fused(model, max_len: int, seq_per_img: int,
+                       temperature: float = 1.0,
+                       greedy_baseline: bool = True) -> Callable:
+    """(params, feats, rng) -> (sampled (B*S, L), fetch).
+
+    The overlapped CST pipeline's rollout: ``sampled`` stays on device for
+    the later grad step; ``fetch`` is the ONE array the host pulls for
+    reward scoring — ``concat([sampled, greedy])`` rows under the greedy
+    baseline, just the sampled rows for SCB baselines.  A single fetch
+    array means a single device->host transfer per step, which matters
+    when the host link is high-latency (remote TPU tunnels pay a full
+    round trip per transfer).
+    """
+
+    def rollout(params, feats, rng):
+        variables = {"params": params}
+        if greedy_baseline:
+            sampled, _, greedy = sample_with_baseline(
+                model, variables, feats, rng, max_len,
+                seq_per_img=seq_per_img, temperature=temperature,
+            )
+            fetch = jnp.concatenate([sampled, greedy], axis=0)
+        else:
+            sampled, _ = sample_captions(
+                model, variables, feats, rng, max_len,
+                seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+            )
+            fetch = sampled
+        return sampled, fetch
 
     return rollout
 

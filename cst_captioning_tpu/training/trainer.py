@@ -22,7 +22,6 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
-import numpy as np
 
 from ..data.dataset import CaptionDataset, SplitPaths
 from ..data.loader import CaptionLoader, prefetch_to_device
@@ -30,13 +29,15 @@ from ..metrics.ciderd import CiderD, build_corpus_df, save_corpus_df
 from ..metrics.consensus import load_consensus, normalize_weights
 from ..metrics.tokenizer import tokenize_corpus
 from ..models.captioner import CaptionModel
+from ..opts import DEFAULT_OVERLAP_REWARDS
 from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
 from .checkpoint import CheckpointManager
 from .evaluation import eval_split
+from .pipeline import RewardPipeline
 from .rewards import RewardComputer
 from .state import create_train_state, make_optimizer, param_count
-from .steps import make_rl_grad_step, make_rollout, make_xe_step
+from .steps import make_rl_grad_step, make_rollout_fused, make_xe_step
 
 log = logging.getLogger("cst_captioning_tpu.train")
 
@@ -211,6 +212,27 @@ class Trainer:
             except ImportError as e:  # tensorboard pkg not installed
                 log.warning("tensorboard writer unavailable: %s", e)
 
+    def _maybe_log_train(self, step1: int, metrics: Dict[str, float],
+                         total_steps: int, bpe: int) -> None:
+        """Console + metrics.jsonl/TB logging for one completed train step,
+        honoring --log_every.  ``step1`` is the 1-based index of the step
+        the metrics belong to (= the loop step for XE; the completing
+        pipeline step under RL overlap)."""
+        if step1 % self.opt.log_every != 0:
+            return
+        m = {k: float(v) for k, v in metrics.items()}
+        dt = time.time() - self._log_t0
+        cps = self._captions_done / max(dt, 1e-9)
+        lr = float(self.lr_sched(step1 - 1))
+        log.info(
+            "step %d/%d epoch %.2f %s lr %.2e | %.0f captions/s",
+            step1, total_steps, step1 / bpe,
+            " ".join(f"{k} {v:.4f}" for k, v in m.items()), lr, cps,
+        )
+        self._log_metrics(step1, "train",
+                          {**m, "lr": lr, "captions_per_sec": cps})
+        self._log_t0, self._captions_done = time.time(), 0
+
     def _log_metrics(self, step: int, scope: str,
                      metrics: Dict[str, float]) -> None:
         if jax.process_index() != 0:  # one metrics stream per pod
@@ -264,15 +286,31 @@ class Trainer:
             scb_captions=opt.scb_captions,
         )
         self.rollout = data_parallel_jit(
-            make_rollout(self.model, opt.max_length, opt.seq_per_img,
-                         temperature=opt.temperature,
-                         greedy_baseline=opt.rl_baseline == "greedy"),
+            make_rollout_fused(self.model, opt.max_length, opt.seq_per_img,
+                               temperature=opt.temperature,
+                               greedy_baseline=opt.rl_baseline == "greedy"),
             self.mesh, batch_argnums=(1,), donate_argnums=(),
+            # sampled flows straight back into rl_step on device, so it must
+            # keep the batch sharding; fetch leaves for the host either way.
+            out_batch_tree=(True, True),
         )
         self.rl_step = data_parallel_jit(
             make_rl_grad_step(self.model, opt.seq_per_img), self.mesh,
             batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
+        # Overlapped CST pipeline (SURVEY §7 step 6): rollouts dispatched
+        # ahead of their reward/grad step, so host CIDEr-D + the tunnel
+        # round trips run while the device computes the next rollout.
+        self._rl_pipeline = RewardPipeline(
+            self.rollout, self.rl_step,
+            # ctx = (absolute step index, video ids): the index keeps
+            # metric attribution honest under the pipeline lag.
+            lambda ctx, s, g: self.reward_computer(ctx[1], s, g),
+            depth=getattr(opt, "overlap_rewards", DEFAULT_OVERLAP_REWARDS),
+        )
+        # Resume-safe rollout key stream: continue from the restored step so
+        # a resumed run never replays the multinomial draws it already used.
+        self._rl_dispatch_step = int(self.state.step)
 
     # -- iteration bodies --------------------------------------------------
 
@@ -282,19 +320,28 @@ class Trainer:
         )
         return metrics
 
-    def _rl_iteration(self, batch) -> Dict[str, float]:
-        step = int(self.state.step)
-        roll_rng = jax.random.fold_in(self.rng, step)
-        sampled, greedy = self.rollout(self.state.params, batch.feats, roll_rng)
-        sampled = np.asarray(jax.device_get(sampled))
-        greedy = np.asarray(jax.device_get(greedy))
-        advantage, stats = self.reward_computer(batch.video_ids, sampled, greedy)
-        self.state, metrics = self.rl_step(
-            self.state, batch.feats, sampled, advantage, self.rng
+    def _rl_iteration(self, batch):
+        """One pipelined CST step (``training.pipeline.RewardPipeline``).
+
+        Depth 0 reproduces the reference's serial semantics exactly; depth
+        k >= 1 grades each sample under params up to k updates newer than
+        the ones that drew it (stale-sample REINFORCE; see PARITY.md).
+        Returns the steps COMPLETED by this call as (step_index, metrics)
+        pairs — empty while the pipeline fills.
+        """
+        roll_rng = jax.random.fold_in(self.rng, self._rl_dispatch_step)
+        ctx = (self._rl_dispatch_step, batch.video_ids)
+        self._rl_dispatch_step += 1
+        self.state, completed = self._rl_pipeline.push(
+            self.state, batch.feats, roll_rng, self.rng, ctx
         )
-        metrics = dict(metrics)
-        metrics.update(stats)
-        return metrics
+        return [(c[0], m) for c, m in completed]
+
+    def _rl_drain(self):
+        """Flush the pipeline (epoch boundary / checkpoint / end of run);
+        returns the flushed steps' (step_index, metrics) for logging."""
+        self.state, completed = self._rl_pipeline.drain(self.state)
+        return [(c[0], m) for c, m in completed]
 
     # -- main loop ---------------------------------------------------------
 
@@ -324,8 +371,12 @@ class Trainer:
         best = self.ckpt.infos.get("best_score")
         best = float("-inf") if best is None else float(best)
         patience = 0
-        t0 = time.time()
-        captions_done = 0
+        self._log_t0 = time.time()
+        self._captions_done = 0
+
+        def drain_and_log():
+            for k, m in self._rl_drain():
+                self._maybe_log_train(k + 1, m, total_steps, bpe)
 
         profiling = False
         for step in range(start_step, total_steps):
@@ -338,32 +389,27 @@ class Trainer:
                     profiling = False
                     log.info("profiler trace written to %s", opt.profile_dir)
             batch = next(it)
-            metrics = (self._rl_iteration(batch) if opt.use_rl
-                       else self._xe_iteration(batch))
-            captions_done += opt.batch_size * opt.seq_per_img
-
-            if (step + 1) % opt.log_every == 0:
-                m = {k: float(v) for k, v in metrics.items()}
-                dt = time.time() - t0
-                cps = captions_done / max(dt, 1e-9)
-                log.info(
-                    "step %d/%d epoch %.2f %s lr %.2e | %.0f captions/s",
-                    step + 1, total_steps, (step + 1) / bpe,
-                    " ".join(f"{k} {v:.4f}" for k, v in m.items()),
-                    float(self.lr_sched(step)),
-                    cps,
+            self._captions_done += opt.batch_size * opt.seq_per_img
+            if opt.use_rl:
+                # Completed steps lag dispatch by the pipeline depth; each
+                # is logged under ITS OWN step index, not the loop's.
+                for k, m in self._rl_iteration(batch):
+                    self._maybe_log_train(k + 1, m, total_steps, bpe)
+            else:
+                self._maybe_log_train(
+                    step + 1, self._xe_iteration(batch), total_steps, bpe
                 )
-                self._log_metrics(step + 1, "train",
-                                  {**m, "lr": float(self.lr_sched(step)),
-                                   "captions_per_sec": cps})
-                t0, captions_done = time.time(), 0
 
             if (opt.save_every_steps
                     and (step + 1) % opt.save_every_steps == 0
                     and (step + 1) % bpe != 0):  # epoch boundary saves below
+                if opt.use_rl:
+                    drain_and_log()  # checkpoint must include all updates
                 self.ckpt.save_recovery(step + 1, self.state)
 
             if (step + 1) % bpe == 0:  # epoch boundary
+                if opt.use_rl:
+                    drain_and_log()  # validate/ckpt on fully-updated params
                 scores = self.validate()
                 if scores is not None:
                     metric = scores.get(opt.eval_metric, 0.0)
@@ -387,6 +433,8 @@ class Trainer:
                 else:
                     self.ckpt.save(step + 1, self.state)
 
+        if opt.use_rl:
+            drain_and_log()  # no-op unless the run ended mid-pipeline
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
         return {

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Async-dispatch timing: rollout-only, grad-only, and pipelined CST loops.
+
+Measures steady-state device throughput the way the XE bench does (queue N
+steps, block once) to separate real device time from tunnel round-trip
+latency that per-step block_until_ready measurements include.
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--seq_per_img", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=8000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--bfloat16", type=int, default=1)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.devices()[0].platform)
+
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.native import NativeCiderD
+    from cst_captioning_tpu.training.rewards import RewardComputer
+    from cst_captioning_tpu.training.steps import make_rl_grad_step, make_rollout
+
+    model, state, feats, labels = build(
+        args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+        args.hidden, args.bfloat16,
+    )
+    vocab = Vocab({i: f"w{i}" for i in range(1, args.vocab)})
+    rng = np.random.default_rng(1)
+    refs = {
+        f"v{i}": [
+            " ".join(f"w{w}" for w in rng.integers(1, args.vocab, 10))
+            for _ in range(20)
+        ]
+        for i in range(args.batch_size)
+    }
+    scorer = NativeCiderD(refs, vocab.word_to_ix)
+    rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
+                        baseline="greedy")
+    video_ids = list(refs.keys())
+    caps = args.batch_size * args.seq_per_img
+
+    rollout = jax.jit(make_rollout(model, args.seq_len, args.seq_per_img))
+    rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
+                      donate_argnums=(0,))
+
+    # warm up / compile
+    sampled, greedy = rollout(state.params, feats, jax.random.PRNGKey(0))
+    s = np.asarray(jax.device_get(sampled))
+    g = np.asarray(jax.device_get(greedy))
+    adv, _ = rc(video_ids, s, g)
+    adv = jnp.asarray(adv)
+    state, m = rl_step(state, feats, sampled, adv, jax.random.PRNGKey(0))
+    jax.block_until_ready(m["loss"])
+
+    # -- rollout-only, async queue ----------------------------------------
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(args.steps):
+        sampled, greedy = rollout(state.params, feats, jax.random.PRNGKey(i))
+        outs.append(sampled)
+    jax.block_until_ready(outs[-1])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"rollout async: {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+    # -- grad-only, async queue -------------------------------------------
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, m = rl_step(state, feats, sampled, adv, jax.random.PRNGKey(i))
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"rl_step async: {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+    # -- pipelined CST loop: reward of step t overlaps rollout t+1 --------
+    t0 = time.perf_counter()
+    pending = None
+    for i in range(args.steps):
+        key = jax.random.PRNGKey(100 + i)
+        sampled, greedy = rollout(state.params, feats, key)
+        try:
+            sampled.copy_to_host_async()
+            greedy.copy_to_host_async()
+        except AttributeError:
+            pass
+        if pending is not None:
+            ps, pg, pkey = pending
+            s = np.asarray(ps)
+            g = np.asarray(pg)
+            adv, _ = rc(video_ids, s, g)
+            state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+        pending = (sampled, greedy, key)
+    ps, pg, pkey = pending
+    adv, _ = rc(video_ids, np.asarray(ps), np.asarray(pg))
+    state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"pipelined cst: {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+    # -- pipelined, single fused fetch (concat sampled+greedy on device) --
+    @jax.jit
+    def rollout_cat(params, f, key):
+        s, g = make_rollout(model, args.seq_len, args.seq_per_img)(params, f, key)
+        return s, g, jnp.concatenate([s, g], axis=0)
+
+    s, g, cat = rollout_cat(state.params, feats, jax.random.PRNGKey(0))
+    jax.block_until_ready(cat)
+    t0 = time.perf_counter()
+    pending = None
+    for i in range(args.steps):
+        key = jax.random.PRNGKey(300 + i)
+        sampled, greedy, cat = rollout_cat(state.params, feats, key)
+        try:
+            cat.copy_to_host_async()
+        except AttributeError:
+            pass
+        if pending is not None:
+            ps, pcat, pkey = pending
+            both = np.asarray(pcat)
+            adv, _ = rc(video_ids, both[:caps], both[caps:])
+            state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+        pending = (sampled, cat, key)
+    ps, pcat, pkey = pending
+    both = np.asarray(pcat)
+    adv, _ = rc(video_ids, both[:caps], both[caps:])
+    state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"pipelined+cat: {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+    # -- depth-2 pipeline + fused fetch -----------------------------------
+    from collections import deque
+    t0 = time.perf_counter()
+    q = deque()
+    for i in range(args.steps):
+        key = jax.random.PRNGKey(400 + i)
+        sampled, greedy, cat = rollout_cat(state.params, feats, key)
+        try:
+            cat.copy_to_host_async()
+        except AttributeError:
+            pass
+        q.append((sampled, cat, key))
+        if len(q) > 2:
+            ps, pcat, pkey = q.popleft()
+            both = np.asarray(pcat)
+            adv, _ = rc(video_ids, both[:caps], both[caps:])
+            state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+    while q:
+        ps, pcat, pkey = q.popleft()
+        both = np.asarray(pcat)
+        adv, _ = rc(video_ids, both[:caps], both[caps:])
+        state, m = rl_step(state, feats, ps, jnp.asarray(adv), pkey)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"depth2+cat:    {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+    # -- serial CST loop (reference semantics, no overlap) ----------------
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        key = jax.random.PRNGKey(200 + i)
+        sampled, greedy = rollout(state.params, feats, key)
+        adv, _ = rc(video_ids, np.asarray(jax.device_get(sampled)),
+                    np.asarray(jax.device_get(greedy)))
+        state, m = rl_step(state, feats, sampled, jnp.asarray(adv), key)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / args.steps
+    print(f"serial cst:    {dt*1000:.1f}ms/step  ({caps/dt:.0f} caps/s)")
+
+
+if __name__ == "__main__":
+    main()

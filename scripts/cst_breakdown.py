@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""One-off: time each phase of the CST iteration on the current backend.
+
+Phases: rollout (jit), device->host transfer, reward (native + python),
+RL grad step (jit).  Mirrors bench.py --stage cst shapes.
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--seq_per_img", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=8000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--bfloat16", type=int, default=1)
+    p.add_argument("--python_scorer", type=int, default=0)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    print("platform:", jax.devices()[0].platform)
+
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+    from cst_captioning_tpu.native import NativeCiderD
+    from cst_captioning_tpu.training.rewards import RewardComputer
+    from cst_captioning_tpu.training.steps import make_rl_grad_step, make_rollout
+
+    model, state, feats, labels = build(
+        args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+        args.hidden, args.bfloat16,
+    )
+    vocab = Vocab({i: f"w{i}" for i in range(1, args.vocab)})
+    rng = np.random.default_rng(1)
+    refs = {
+        f"v{i}": [
+            " ".join(f"w{w}" for w in rng.integers(1, args.vocab, 10))
+            for _ in range(20)
+        ]
+        for i in range(args.batch_size)
+    }
+    if args.python_scorer:
+        df, n = build_corpus_df(refs)
+        scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    else:
+        scorer = NativeCiderD(refs, vocab.word_to_ix)
+    rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
+                        baseline="greedy")
+    video_ids = list(refs.keys())
+
+    rollout = jax.jit(make_rollout(model, args.seq_len, args.seq_per_img))
+    rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
+                      donate_argnums=(0,))
+
+    # compile
+    t0 = time.perf_counter()
+    sampled, greedy = rollout(state.params, feats, jax.random.PRNGKey(0))
+    jax.block_until_ready(sampled)
+    print(f"rollout compile+run: {time.perf_counter()-t0:.1f}s")
+    s = np.asarray(jax.device_get(sampled))
+    g = np.asarray(jax.device_get(greedy))
+    adv, _ = rc(video_ids, s, g)
+    t0 = time.perf_counter()
+    state, m = rl_step(state, feats, sampled, jnp.asarray(adv),
+                       jax.random.PRNGKey(0))
+    jax.block_until_ready(m["loss"])
+    print(f"rl_step compile+run: {time.perf_counter()-t0:.1f}s")
+
+    times = {"rollout": 0.0, "get": 0.0, "reward": 0.0, "grad": 0.0}
+    n_steps = args.steps
+    for i in range(n_steps):
+        key = jax.random.PRNGKey(i + 1)
+        t0 = time.perf_counter()
+        sampled, greedy = rollout(state.params, feats, key)
+        jax.block_until_ready(sampled)
+        t1 = time.perf_counter()
+        s = np.asarray(jax.device_get(sampled))
+        g = np.asarray(jax.device_get(greedy))
+        t2 = time.perf_counter()
+        adv, _ = rc(video_ids, s, g)
+        t3 = time.perf_counter()
+        state, m = rl_step(state, feats, sampled, jnp.asarray(adv), key)
+        jax.block_until_ready(m["loss"])
+        t4 = time.perf_counter()
+        times["rollout"] += t1 - t0
+        times["get"] += t2 - t1
+        times["reward"] += t3 - t2
+        times["grad"] += t4 - t3
+    total = sum(times.values())
+    caps = args.batch_size * args.seq_per_img * n_steps
+    print({k: f"{v/n_steps*1000:.1f}ms" for k, v in times.items()})
+    print(f"total/step: {total/n_steps*1000:.1f}ms  "
+          f"captions/s: {caps/total:.0f}")
+
+
+if __name__ == "__main__":
+    main()

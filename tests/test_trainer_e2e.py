@@ -177,6 +177,23 @@ def test_manet_fusion_stage(data, tmp_path_factory):
     assert res["best_score"] is not None
 
 
+def test_cst_overlap_depths(data, tmp_path_factory):
+    """The overlapped reward pipeline (--overlap_rewards k) must drain at
+    epoch boundaries: every dispatched rollout gets its grad step, so
+    state.step ends at batches-per-epoch regardless of depth.  Depth 0 is
+    the strict serial reference semantics."""
+    out = str(tmp_path_factory.mktemp("depths"))
+    for depth in (0, 2):
+        res = run_stage(
+            data, os.path.join(out, f"d{depth}"),
+            **{"--use_rl": ["1"],
+               "--overlap_rewards": [str(depth)],
+               "--max_epochs": ["1"]},
+        )
+        assert res["last_step"] == 2, f"depth {depth} lost pipelined steps"
+        assert res["best_score"] is not None
+
+
 def test_scb_sample_stage(data, tmp_path_factory):
     out = str(tmp_path_factory.mktemp("scb"))
     res = run_stage(
