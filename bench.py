@@ -80,6 +80,48 @@ def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
     return model, state, feats, labels
 
 
+def synthetic_rewarder(batch: int, seq_per_img: int, vocab_size: int,
+                       native: bool = True):
+    """Vocab + synthetic 20-refs-per-video corpus + CIDEr-D scorer +
+    RewardComputer — the CST reward scaffolding shared by ``bench_cst`` and
+    the ``scripts/`` probes so their measurements can't drift apart.
+
+    Returns (reward_computer, video_ids, scorer_kind) where scorer_kind is
+    "native" or "python" (fallback when the C++ build is unavailable).
+    """
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.training.rewards import RewardComputer
+
+    vocab = Vocab({i: f"w{i}" for i in range(1, vocab_size)})
+    rng = np.random.default_rng(1)
+    refs = {
+        f"v{i}": [
+            " ".join(f"w{w}" for w in rng.integers(1, vocab_size, 10))
+            for _ in range(20)
+        ]
+        for i in range(batch)
+    }
+    scorer = None
+    scorer_kind = "python"
+    if native:
+        try:
+            from cst_captioning_tpu.native import NativeCiderD
+
+            scorer = NativeCiderD(refs, vocab.word_to_ix)
+            scorer_kind = "native"
+        except Exception as e:
+            print(f"bench: native CIDEr-D unavailable ({e}); using Python",
+                  file=sys.stderr)
+    if scorer is None:
+        from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+
+        df, n = build_corpus_df(refs)
+        scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    rc = RewardComputer(vocab, scorer, refs, seq_per_img=seq_per_img,
+                        baseline="greedy")
+    return rc, list(refs.keys()), scorer_kind
+
+
 def bench_xe(args):
     import jax
     import jax.numpy as jnp
@@ -113,10 +155,8 @@ def bench_cst(args):
     """
     import jax
 
-    from cst_captioning_tpu.data.vocab import Vocab
     from cst_captioning_tpu.opts import DEFAULT_OVERLAP_REWARDS
     from cst_captioning_tpu.training.pipeline import RewardPipeline
-    from cst_captioning_tpu.training.rewards import RewardComputer
     from cst_captioning_tpu.training.steps import (
         make_rl_grad_step,
         make_rollout_fused,
@@ -126,35 +166,10 @@ def bench_cst(args):
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
         args.hidden, args.bfloat16,
     )
-    vocab = Vocab({i: f"w{i}" for i in range(1, args.vocab)})
-    # synthetic reference corpus: 20 refs per video, ~10 tokens each
-    rng = np.random.default_rng(1)
-    refs = {
-        f"v{i}": [
-            " ".join(f"w{w}" for w in rng.integers(1, args.vocab, 10))
-            for _ in range(20)
-        ]
-        for i in range(args.batch_size)
-    }
-    scorer = None
-    scorer_kind = "python"
-    if args.native_cider:
-        try:
-            from cst_captioning_tpu.native import NativeCiderD
-
-            scorer = NativeCiderD(refs, vocab.word_to_ix)
-            scorer_kind = "native"
-        except Exception as e:
-            print(f"bench: native CIDEr-D unavailable ({e}); using Python",
-                  file=sys.stderr)
-    if scorer is None:
-        from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
-
-        df, n = build_corpus_df(refs)
-        scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
-    rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
-                        baseline="greedy")
-    video_ids = list(refs.keys())
+    rc, video_ids, scorer_kind = synthetic_rewarder(
+        args.batch_size, args.seq_per_img, args.vocab,
+        native=bool(args.native_cider),
+    )
     ncaps = args.batch_size * args.seq_per_img
 
     rollout = jax.jit(make_rollout_fused(model, args.seq_len, args.seq_per_img))

@@ -221,17 +221,23 @@ class Trainer:
         if step1 % self.opt.log_every != 0:
             return
         m = {k: float(v) for k, v in metrics.items()}
-        dt = time.time() - self._log_t0
-        cps = self._captions_done / max(dt, 1e-9)
         lr = float(self.lr_sched(step1 - 1))
+        extra = {"lr": lr}
+        cps_txt = ""
+        if self._captions_done:  # 0 for steps logged mid-drain-burst:
+            # their captions were already counted by the first drained step,
+            # so a cps there would be a spurious zero in the metrics stream.
+            dt = time.time() - self._log_t0
+            cps = self._captions_done / max(dt, 1e-9)
+            extra["captions_per_sec"] = cps
+            cps_txt = f" | {cps:.0f} captions/s"
+            self._log_t0, self._captions_done = time.time(), 0
         log.info(
-            "step %d/%d epoch %.2f %s lr %.2e | %.0f captions/s",
+            "step %d/%d epoch %.2f %s lr %.2e%s",
             step1, total_steps, step1 / bpe,
-            " ".join(f"{k} {v:.4f}" for k, v in m.items()), lr, cps,
+            " ".join(f"{k} {v:.4f}" for k, v in m.items()), lr, cps_txt,
         )
-        self._log_metrics(step1, "train",
-                          {**m, "lr": lr, "captions_per_sec": cps})
-        self._log_t0, self._captions_done = time.time(), 0
+        self._log_metrics(step1, "train", {**m, **extra})
 
     def _log_metrics(self, step: int, scope: str,
                      metrics: Dict[str, float]) -> None:

@@ -30,29 +30,17 @@ def main():
     import os
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import build
-    from cst_captioning_tpu.data.vocab import Vocab
-    from cst_captioning_tpu.native import NativeCiderD
-    from cst_captioning_tpu.training.rewards import RewardComputer
+    from bench import build, synthetic_rewarder
     from cst_captioning_tpu.training.steps import make_rl_grad_step, make_rollout
 
     model, state, feats, labels = build(
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
         args.hidden, args.bfloat16,
     )
-    vocab = Vocab({i: f"w{i}" for i in range(1, args.vocab)})
-    rng = np.random.default_rng(1)
-    refs = {
-        f"v{i}": [
-            " ".join(f"w{w}" for w in rng.integers(1, args.vocab, 10))
-            for _ in range(20)
-        ]
-        for i in range(args.batch_size)
-    }
-    scorer = NativeCiderD(refs, vocab.word_to_ix)
-    rc = RewardComputer(vocab, scorer, refs, seq_per_img=args.seq_per_img,
-                        baseline="greedy")
-    video_ids = list(refs.keys())
+    rc, video_ids, scorer_kind = synthetic_rewarder(
+        args.batch_size, args.seq_per_img, args.vocab
+    )
+    print("scorer:", scorer_kind)
     caps = args.batch_size * args.seq_per_img
 
     rollout = jax.jit(make_rollout(model, args.seq_len, args.seq_per_img))
