@@ -69,7 +69,11 @@ class CaptionLoader:
         self._refs = dataset.references() if include_gts else None
 
         # Multi-host shard: strided so every process gets an equal slice
-        # regardless of dataset ordering.
+        # regardless of dataset ordering.  The stride is PUBLIC contract:
+        # evaluation.gather_strided_predictions reconstructs every other
+        # host's shard from (process_index, process_count, num_videos).
+        self.process_index = process_index
+        self.process_count = process_count
         self._my_videos = np.arange(dataset.num_videos)[process_index::process_count]
         if len(self._my_videos) == 0:
             raise ValueError("process shard is empty; dataset smaller than host count")

@@ -89,12 +89,31 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.ciderd_num_refs.restype = ctypes.c_int
         lib.ciderd_num_refs.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ciderd_set_df.restype = ctypes.c_int
+        lib.ciderd_set_df.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+        ]
         _loaded = lib
         return lib
 
 
 def _as_i32_ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+_U64 = (1 << 64) - 1
+
+
+def fnv_ngram_hash(ids) -> int:
+    """Python replica of ciderd.cpp's ``ngram_hash`` (FNV-1a over
+    (order, ids...)) — MUST stay bit-identical to the C++ so external df
+    tables hash into the same buckets as the library's own cooking."""
+    h = (1469598103934665603 ^ len(ids)) & _U64
+    for i in ids:
+        h ^= ((int(i) & 0xFFFFFFFF) + 0x9E3779B9) & _U64
+        h = (h * 1099511628211) & _U64
+    return h
 
 
 class NativeCiderD:
@@ -140,24 +159,48 @@ class NativeCiderD:
             self.close()
             raise
 
+    def _word_id(self, w: str) -> int:
+        ix = self._w2i.get(w)
+        if ix is None:
+            ix = self._next_id
+            self._w2i[w] = ix
+            self._next_id += 1
+        return ix
+
     def _encode(self, caption: str) -> np.ndarray:
-        ids = []
-        for w in caption.split():
-            ix = self._w2i.get(w)
-            if ix is None:
-                ix = self._next_id
-                self._w2i[w] = ix
-                self._next_id += 1
-            ids.append(ix)
-        return np.asarray(ids, dtype=np.int32)
+        return np.asarray(
+            [self._word_id(w) for w in caption.split()], dtype=np.int32
+        )
+
+    def load_df(self, df, ref_len: float) -> None:
+        """Install an external corpus document-frequency table — the
+        reference's ``--train_cached_tokens`` pickle
+        (``metrics.ciderd.load_corpus_df`` format: {ngram word tuple:
+        doc count}, ref_len documents).  Replaces the df built from this
+        run's references and rebuilds the reference TF-IDF vectors, so
+        scores match a Python ``CiderD(df_mode="corpus", df_path=...)``
+        exactly (tests/test_native_ciderd.py pickle-path parity)."""
+        hashes = np.asarray(
+            [fnv_ngram_hash([self._word_id(w) for w in ng]) for ng in df],
+            dtype=np.uint64,
+        )
+        counts = np.asarray(list(df.values()), dtype=np.float64)
+        rc = self._lib.ciderd_set_df(
+            self._handle,
+            hashes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(hashes), float(ref_len),
+        )
+        if rc != 0:
+            raise RuntimeError(f"ciderd_set_df failed with code {rc}")
 
     # -- scoring -----------------------------------------------------------
 
     def score_ids(self, video_ids: Sequence[str],
                   hyps: np.ndarray) -> np.ndarray:
-        """Score 0-terminated id rows (N, L); row i belongs to
-        ``video_ids[i * len(video_ids) // N]`` — i.e. N must be a multiple
-        of len(video_ids), rows grouped per video (the rollout layout)."""
+        """Score 0-terminated id rows (N, L); N must be a multiple of
+        len(video_ids), rows grouped per video (the rollout layout): row i
+        belongs to ``video_ids[i // (N // len(video_ids))]``."""
         hyps = np.ascontiguousarray(hyps, dtype=np.int32)
         n_hyps, max_len = hyps.shape
         if n_hyps % len(video_ids) != 0:

@@ -116,6 +116,23 @@ void ciderd_add_video(void* handle, const int32_t* tokens_flat,
   s->raw.push_back(std::move(cooked));
 }
 
+namespace {
+
+// (Re)build every reference's TF-IDF vector from the current df table.
+void build_vectors(Scorer* s) {
+  s->videos.clear();
+  s->videos.resize(s->raw.size());
+  for (size_t v = 0; v < s->raw.size(); ++v) {
+    s->videos[v].resize(s->raw[v].size());
+    for (size_t r = 0; r < s->raw[v].size(); ++r) {
+      to_tfidf(*s, s->raw[v][r], &s->videos[v][r]);
+    }
+  }
+  s->finalized = true;
+}
+
+}  // namespace
+
 // Builds corpus document frequencies (df = number of videos whose reference
 // set contains the n-gram) and the per-reference TF-IDF vectors.
 void ciderd_finalize(void* handle) {
@@ -132,16 +149,23 @@ void ciderd_finalize(void* handle) {
   }
   double nd = static_cast<double>(s->raw.size());
   s->log_ref_len = std::log(nd < 1.0 ? 1.0 : nd);
+  build_vectors(s);
+}
 
-  s->videos.clear();
-  s->videos.resize(s->raw.size());
-  for (size_t v = 0; v < s->raw.size(); ++v) {
-    s->videos[v].resize(s->raw[v].size());
-    for (size_t r = 0; r < s->raw[v].size(); ++r) {
-      to_tfidf(*s, s->raw[v][r], &s->videos[v][r]);
-    }
-  }
-  s->finalized = true;
+// Replace the document-frequency table with an EXTERNAL corpus df (the
+// reference's --train_cached_tokens pickle): hashes[i] (ngram_hash of the
+// id-encoded n-gram) -> counts[i], over ref_len documents.  Rebuilds the
+// reference TF-IDF vectors under the new weights.  Call after add_video
+// (+finalize); scoring then matches a Python CiderD loaded from the pickle.
+int ciderd_set_df(void* handle, const uint64_t* hashes, const double* counts,
+                  int n_entries, double ref_len) {
+  auto* s = static_cast<Scorer*>(handle);
+  if (n_entries < 0 || ref_len < 1.0) return -1;
+  s->df.clear();
+  for (int i = 0; i < n_entries; ++i) s->df[hashes[i]] = counts[i];
+  s->log_ref_len = std::log(ref_len);
+  build_vectors(s);
+  return 0;
 }
 
 int ciderd_num_videos(void* handle) {

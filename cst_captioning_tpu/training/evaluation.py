@@ -25,16 +25,128 @@ from ..ops.sampling import jit_sampler
 _DECODER_CACHE: dict = {}
 
 
-def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float):
-    key = (model, beam_size, max_len, length_norm)
+def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float,
+                      mesh=None):
+    """Compile (and memoize) the greedy/beam decoder; with ``mesh`` the
+    batch is sharded over the ``data`` axis so validation/eval decode
+    scales with the device count instead of idling every chip but one
+    (VERDICT.md round 2 item 7 / SURVEY §6 config 5)."""
+    key = (model, beam_size, max_len, length_norm, mesh)
     fn = _DECODER_CACHE.get(key)
     if fn is None:
         if beam_size > 1:
-            fn = jit_beam_search(model, beam_size, max_len, length_norm)
+            if mesh is None:
+                fn = jit_beam_search(model, beam_size, max_len, length_norm)
+            else:
+                from ..ops.beam import beam_search
+                from ..parallel.dp import data_parallel_jit
+
+                fn = data_parallel_jit(
+                    lambda variables, feats: beam_search(
+                        model, variables, feats, beam_size, max_len,
+                        length_norm),
+                    mesh, batch_argnums=(1,), donate_argnums=(),
+                )
         else:
-            fn = jit_sampler(model, max_len, seq_per_img=1, greedy=True)
+            if mesh is None:
+                fn = jit_sampler(model, max_len, seq_per_img=1, greedy=True)
+            else:
+                from ..ops.sampling import sample_captions
+                from ..parallel.dp import data_parallel_jit
+
+                fn = data_parallel_jit(
+                    lambda variables, feats, rng: sample_captions(
+                        model, variables, feats, rng, max_len, greedy=True),
+                    mesh, batch_argnums=(1,), donate_argnums=(),
+                )
         _DECODER_CACHE[key] = fn
     return fn
+
+
+def _decode_local(
+    model, params, loader: CaptionLoader, max_len: int,
+    beam_size: int, length_norm: float, mesh=None,
+) -> Tuple[List[str], List[np.ndarray]]:
+    """Decode THIS host's loader shard -> (video_ids, token rows), deduped
+    of the static-shape wrap padding, in shard (dataset) order."""
+    if mesh is not None and (loader.process_count > 1
+                             or loader.batch_size % mesh.shape["data"] != 0):
+        # Sharded decode only on single-host meshes: under multi-host each
+        # process feeds a DIFFERENT local batch, and jitting that against a
+        # global-mesh sharding would stitch unrelated hosts' rows into one
+        # bogus global batch.  Pods decode one-device-per-host and rely on
+        # gather_strided_predictions for consistency; batches that don't
+        # divide the mesh also fall back to single-device decode.
+        mesh = None
+    variables = {"params": params}
+    if beam_size > 1:
+        beam = _compiled_decoder(model, beam_size, max_len, length_norm, mesh)
+        decode = lambda feats: beam(variables, feats)[0]
+    else:
+        sampler = _compiled_decoder(model, 1, max_len, length_norm, mesh)
+        decode = lambda feats: sampler(variables, feats,
+                                       jax.random.PRNGKey(0))[0]
+    seen = set()
+    ids: List[str] = []
+    rows: List[np.ndarray] = []
+    for batch in loader.iter_eval():
+        tokens = np.asarray(jax.device_get(decode(batch.feats)))
+        for vid, row in zip(batch.video_ids, tokens):
+            if vid in seen:
+                continue
+            seen.add(vid)
+            ids.append(vid)
+            rows.append(row)
+    return ids, rows
+
+
+def gather_strided_predictions(
+    local_tokens: np.ndarray,
+    all_video_ids: Sequence[str],
+    process_index: int,
+    process_count: int,
+    allgather=None,
+) -> Tuple[List[str], List[np.ndarray]]:
+    """Reassemble the FULL split's decoded tokens from per-host shards.
+
+    The loader strides the split deterministically (host q owns dataset
+    indices ``q::process_count`` — data/loader.py), so every host can
+    reconstruct which rows the others hold from the stride alone; only the
+    token arrays cross hosts.  Shards are padded to a common row count so
+    the all-gather has one static shape.
+
+    This is what makes multi-host validation CONSISTENT: every process
+    scores the identical full prediction set, so best-checkpoint
+    bookkeeping (trainer best_step / early stop) cannot diverge across
+    hosts (VERDICT.md round 2 item 4).
+
+    ``allgather``: (maxn, L) -> (P, maxn, L); defaults to
+    ``jax.experimental.multihost_utils.process_allgather`` (injectable so
+    single-process tests can simulate a pod).
+    """
+    n_total = len(all_video_ids)
+    shards = [list(range(q, n_total, process_count))
+              for q in range(process_count)]
+    if len(local_tokens) != len(shards[process_index]):
+        raise ValueError(
+            f"host {process_index} decoded {len(local_tokens)} rows, "
+            f"expected {len(shards[process_index])} for its stride"
+        )
+    maxn = max(len(s) for s in shards)
+    padded = np.zeros((maxn,) + local_tokens.shape[1:], local_tokens.dtype)
+    padded[: len(local_tokens)] = local_tokens
+    if allgather is None:
+        from jax.experimental import multihost_utils
+
+        allgather = multihost_utils.process_allgather
+    gathered = np.asarray(allgather(padded))          # (P, maxn, L)
+    ids: List[str] = []
+    rows: List[np.ndarray] = []
+    for q, shard in enumerate(shards):
+        for j, ix in enumerate(shard):
+            ids.append(all_video_ids[ix])
+            rows.append(gathered[q, j])
+    return ids, rows
 
 
 def decode_split(
@@ -45,32 +157,26 @@ def decode_split(
     max_len: int,
     beam_size: int = 1,
     length_norm: float = 0.0,
+    allgather=None,
+    mesh=None,
 ) -> List[Dict[str, str]]:
-    """One ordered pass over ``loader``'s split -> [{"image_id", "caption"}].
+    """One ordered pass over the split -> [{"image_id", "caption"}].
 
     beam_size == 1 uses the greedy sampler; > 1 the batched beam search.
-    Wrap-padding rows (loader.iter_eval keeps shapes static) are deduped by
-    video id, keeping the first occurrence.
+    With ``mesh`` the decode batch shards over the ``data`` axis.  Under
+    multi-host (loader.process_count > 1) each host decodes its own shard
+    and the shards are all-gathered, so EVERY host returns the full
+    split's predictions in the same order.
     """
-    variables = {"params": params}
-    if beam_size > 1:
-        beam = _compiled_decoder(model, beam_size, max_len, length_norm)
-        decode = lambda feats: beam(variables, feats)[0]
-    else:
-        sampler = _compiled_decoder(model, 1, max_len, length_norm)
-        decode = lambda feats: sampler(variables, feats,
-                                       jax.random.PRNGKey(0))[0]
-
-    seen = set()
-    preds: List[Dict[str, str]] = []
-    for batch in loader.iter_eval():
-        tokens = np.asarray(jax.device_get(decode(batch.feats)))
-        for vid, row in zip(batch.video_ids, tokens):
-            if vid in seen:
-                continue
-            seen.add(vid)
-            preds.append({"image_id": vid, "caption": vocab.decode(row)})
-    return preds
+    ids, rows = _decode_local(model, params, loader, max_len,
+                              beam_size, length_norm, mesh)
+    if loader.process_count > 1:
+        ids, rows = gather_strided_predictions(
+            np.stack(rows), loader.ds.video_ids,
+            loader.process_index, loader.process_count, allgather,
+        )
+    return [{"image_id": v, "caption": vocab.decode(r)}
+            for v, r in zip(ids, rows)]
 
 
 def eval_split(
@@ -83,9 +189,11 @@ def eval_split(
     beam_size: int = 1,
     length_norm: float = 0.0,
     scorers: Optional[Sequence[str]] = None,
+    mesh=None,
 ) -> Tuple[List[Dict[str, str]], Dict[str, float]]:
     """Decode + score one split -> (predictions, metric dict)."""
     preds = decode_split(model, params, loader, vocab, max_len,
-                         beam_size=beam_size, length_norm=length_norm)
+                         beam_size=beam_size, length_norm=length_norm,
+                         mesh=mesh)
     scores = language_eval(preds, refs, scorers=scorers)
     return preds, scores

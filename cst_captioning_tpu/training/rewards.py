@@ -21,9 +21,12 @@ flag-selectable):
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger("cst_captioning_tpu.rewards")
 
 from ..data.vocab import Vocab
 from ..metrics.ciderd import CiderD
@@ -64,6 +67,7 @@ class RewardComputer:
         self.seq_per_img = seq_per_img
         self.baseline = baseline
         self.scb_captions = scb_captions
+        self._warned_missing_consensus = False
         self._scb_gt_cache: Dict[str, float] = {}
         if consensus_scores is not None:
             for vid, s in consensus_scores.items():
@@ -111,6 +115,18 @@ class RewardComputer:
             loo = (per_vid.sum(axis=1, keepdims=True) - per_vid) / (S - 1)
             baseline = loo.reshape(-1)
         else:  # scb-gt
+            missing = [v for v in video_ids if v not in self._scb_gt_cache]
+            if missing and not self._warned_missing_consensus:
+                # A mismatched consensus pickle would otherwise degrade
+                # training invisibly (baseline 0 => inflated advantage).
+                log.warning(
+                    "scb-gt baseline: %d video(s) missing from the "
+                    "consensus pickle (e.g. %s); their baseline falls back "
+                    "to 0.0 — check --train_bcmrscores_pkl matches the "
+                    "training split (warned once)",
+                    len(missing), missing[:3],
+                )
+                self._warned_missing_consensus = True
             baseline = np.repeat(
                 [self._scb_gt_cache.get(v, 0.0) for v in video_ids], S
             )

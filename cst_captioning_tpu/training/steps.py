@@ -125,15 +125,22 @@ def make_rl_grad_step(model, seq_per_img: int) -> Callable:
     the current params (teacher-forcing the samples), then
     ``reward_loss`` = -E[advantage * log p].  ``advantage`` (B*S,) comes
     from the host reward computation and is stop-gradiented inside the loss.
+
+    The recompute runs ``train=False`` — NO dropout — so the policy whose
+    log-probs are reinforced is exactly the policy that drew the samples
+    (the rollout scan is deterministic-parameter sampling).  Recomputing
+    under dropout would reinforce a different, randomly-thinned policy each
+    step; decision + parity test in PARITY.md / tests/test_training.py
+    (``rng`` stays in the signature for interface stability).
     """
 
     def step(state: TrainState, feats, sampled, advantage, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
+        del rng  # see docstring: grad recompute is deterministic
 
         def loss_fn(params):
             logits = state.apply_fn(
                 {"params": params}, feats, sampled, seq_per_img,
-                train=True, rngs={"dropout": dropout_rng},
+                train=False,
             )
             logp = token_logprobs(logits, sampled)
             return reward_loss(logp, sampled, advantage)

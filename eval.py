@@ -23,6 +23,7 @@ import jax
 from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
 from cst_captioning_tpu.data.loader import CaptionLoader
 from cst_captioning_tpu.opts import parse_opts
+from cst_captioning_tpu.parallel.mesh import make_mesh
 from cst_captioning_tpu.training.checkpoint import CheckpointManager
 from cst_captioning_tpu.training.evaluation import eval_split
 from cst_captioning_tpu.training.state import create_train_state, make_optimizer
@@ -32,8 +33,14 @@ log = logging.getLogger("cst_captioning_tpu.eval")
 
 
 def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
-                        cli_opt: argparse.Namespace):
-    """Rebuild the model from checkpoint infos and restore BEST params."""
+                        cli_opt: argparse.Namespace,
+                        cli_explicit: frozenset = frozenset()):
+    """Rebuild the model from checkpoint infos and restore BEST params.
+
+    Model hyperparams come from the checkpoint's saved opts, EXCEPT flags
+    the user explicitly passed on this command line (``cli_explicit``) —
+    an explicit ``--max_length`` must not be silently overridden by the
+    training-time value."""
     ckpt = CheckpointManager(checkpoint_path)
     saved = ckpt.infos.get("opt")
     if saved:
@@ -42,7 +49,7 @@ def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
                 "model_type", "rnn_size", "input_encoding_size", "num_layers",
                 "att_size", "use_attention", "drop_prob", "num_heads",
                 "num_tx_layers", "use_bfloat16", "max_length", "fusion_type",
-            ) if k in saved
+            ) if k in saved and k not in cli_explicit
         }})
     else:
         log.warning("checkpoint has no saved opts; using CLI model flags")
@@ -69,14 +76,23 @@ def main(argv=None) -> int:
         info_json=opt.test_info_json,
         cocofmt_json=opt.test_cocofmt_file,
     )
+    raw = list(sys.argv[1:] if argv is None else argv)
+    # Only decode-time knobs may override the checkpoint: architecture flags
+    # must match the restored params regardless of what the CLI says.
+    explicit = frozenset(
+        a[2:].split("=", 1)[0] for a in raw if a.startswith("--")
+    ) & {"max_length"}
     with CaptionDataset(paths) as ds:
-        model, params, opt = load_model_for_eval(opt.checkpoint_path, ds, opt)
+        model, params, opt = load_model_for_eval(opt.checkpoint_path, ds, opt,
+                                                 cli_explicit=explicit)
         loader = CaptionLoader(ds, batch_size=opt.eval_batch_size or opt.batch_size,
                                seq_per_img=1, shuffle=False)
+        mesh = make_mesh(jax.devices())  # decode shards over every chip
         preds, scores = eval_split(
             model, params, loader, ds.vocab, opt.max_length,
             ds.references(),
             beam_size=opt.beam_size, length_norm=opt.length_norm,
+            mesh=mesh,
         )
     log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
     if opt.result_file:

@@ -1,0 +1,149 @@
+"""Multi-host validation consistency (VERDICT r2 item 4).
+
+Simulates a pod on one process: every host decodes its strided loader
+shard, shards are all-gathered (injected fake allgather), and each host
+must end up with the IDENTICAL full prediction set — the property that
+keeps best-checkpoint bookkeeping in lockstep across processes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+from cst_captioning_tpu.data.loader import CaptionLoader
+from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.training.evaluation import (
+    _decode_local,
+    decode_split,
+    gather_strided_predictions,
+)
+
+MAX_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("mh"))
+    spec = SyntheticSpec(num_videos=5, captions_per_video=3, max_len=MAX_LEN,
+                         feat_dims=(12, 6), feat_times=(3, 1))
+    art = generate(root, "train", spec)
+    paths = SplitPaths(
+        feat_h5=__import__("json").loads(art["feat_h5"]),
+        label_h5=art["label_h5"], info_json=art["info_json"],
+    )
+    ds = CaptionDataset(paths)
+    model = CaptionModel(vocab_size=ds.vocab.size_with_pad, embed_size=16,
+                         hidden_size=16, attn_size=16, use_attention=True,
+                         dropout_rate=0.0)
+    feats = [np.zeros((2, t, d), np.float32)
+             for t, d in zip(ds.feat_times, ds.feat_dims)]
+    labels = np.ones((2, ds.seq_length), np.int32)
+    params = model.init(jax.random.PRNGKey(0), [np.asarray(f) for f in feats],
+                        labels, 1)["params"]
+    yield ds, model, params
+    ds.close()
+
+
+def _loader(ds, q, P):
+    return CaptionLoader(ds, batch_size=2, seq_per_img=1, shuffle=False,
+                         process_index=q, process_count=P)
+
+
+def test_every_host_reconstructs_identical_full_split(setup):
+    ds, model, params = setup
+    P = 2  # 5 videos -> shard sizes 3 and 2: exercises the gather padding
+
+    # Per-host local decodes (what each process computes on a real pod).
+    shard_rows = []
+    for q in range(P):
+        ids_q, rows_q = _decode_local(model, params, _loader(ds, q, P),
+                                      MAX_LEN, 1, 0.0)
+        assert ids_q == [ds.video_ids[i] for i in range(q, ds.num_videos, P)]
+        shard_rows.append(np.stack(rows_q))
+
+    maxn = max(len(r) for r in shard_rows)
+    stacked = np.stack([
+        np.pad(r, ((0, maxn - len(r)), (0, 0))) for r in shard_rows
+    ])
+    fake_allgather = lambda local: stacked  # what a pod's allgather returns
+
+    # Ground truth: the single-host full decode.
+    full = decode_split(model, params, _loader(ds, 0, 1), ds.vocab, MAX_LEN)
+    full_by_id = {p["image_id"]: p["caption"] for p in full}
+
+    per_host = []
+    for q in range(P):
+        preds = decode_split(model, params, _loader(ds, q, P), ds.vocab,
+                             MAX_LEN, allgather=fake_allgather)
+        per_host.append({p["image_id"]: p["caption"] for p in preds})
+
+    assert per_host[0] == per_host[1], "hosts disagree on the gathered split"
+    assert per_host[0] == full_by_id, "gathered split != single-host decode"
+
+
+def test_gather_rejects_wrong_row_count(setup):
+    ds, _, _ = setup
+    with pytest.raises(ValueError, match="expected"):
+        gather_strided_predictions(
+            np.zeros((1, MAX_LEN), np.int32), ds.video_ids,
+            process_index=0, process_count=2,
+            allgather=lambda x: np.stack([x, x]),
+        )
+
+
+def test_sharded_decode_matches_single_device(setup):
+    """Validation decode routed over the data-parallel mesh (all devices)
+    must produce exactly the single-device predictions; batch sizes that
+    don't divide the mesh fall back to single-device decode."""
+    ds, model, params = setup
+    from cst_captioning_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices())
+    assert mesh.shape["data"] > 1, "test needs the multi-device CPU mesh"
+    base = decode_split(model, params, _loader(ds, 0, 1), ds.vocab, MAX_LEN)
+    # batch_size=2 doesn't divide 8 devices -> exercises the fallback
+    sharded_fallback = decode_split(model, params, _loader(ds, 0, 1),
+                                    ds.vocab, MAX_LEN, mesh=mesh)
+    assert sharded_fallback == base
+    # batch_size == device count -> genuinely sharded decode
+    big = CaptionLoader(ds, batch_size=mesh.shape["data"], seq_per_img=1,
+                        shuffle=False)
+    sharded = decode_split(model, params, big, ds.vocab, MAX_LEN, mesh=mesh)
+    assert {p["image_id"]: p["caption"] for p in sharded} == \
+        {p["image_id"]: p["caption"] for p in base}
+
+
+def test_mesh_dropped_under_multihost(setup):
+    """On a pod each process holds a DIFFERENT local batch, so sharding it
+    over the global mesh would stitch unrelated rows together — the decode
+    must fall back to per-host single-device + gather."""
+    ds, model, params = setup
+    from cst_captioning_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices())
+    P = 2
+    shard_rows = []
+    for q in range(P):
+        _, rows_q = _decode_local(model, params, _loader(ds, q, P),
+                                  MAX_LEN, 1, 0.0)
+        shard_rows.append(np.stack(rows_q))
+    maxn = max(len(r) for r in shard_rows)
+    stacked = np.stack([
+        np.pad(r, ((0, maxn - len(r)), (0, 0))) for r in shard_rows
+    ])
+    base = decode_split(model, params, _loader(ds, 0, 1), ds.vocab, MAX_LEN)
+    preds = decode_split(model, params, _loader(ds, 0, P), ds.vocab, MAX_LEN,
+                         allgather=lambda x: stacked, mesh=mesh)
+    assert {p["image_id"]: p["caption"] for p in preds} == \
+        {p["image_id"]: p["caption"] for p in base}
+
+
+def test_single_process_skips_gather(setup):
+    """process_count == 1 must not touch any allgather machinery."""
+    ds, model, params = setup
+    boom = lambda x: (_ for _ in ()).throw(AssertionError("allgather called"))
+    preds = decode_split(model, params, _loader(ds, 0, 1), ds.vocab,
+                         MAX_LEN, allgather=boom)
+    assert len(preds) == ds.num_videos

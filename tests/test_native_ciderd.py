@@ -94,6 +94,31 @@ class TestParity:
         np.testing.assert_allclose(a, b, rtol=1e-9)
 
 
+class TestExternalDf:
+    def test_pickle_df_parity_with_python_scorer(self, refs):
+        """--train_cached_tokens path: the native scorer loaded with an
+        EXTERNAL corpus df (built over a superset corpus, so it differs
+        from this run's refs-derived df) must match the Python scorer
+        loaded from the same table."""
+        big_corpus = {**refs, **make_refs(num_videos=25, seed=9)}
+        df, ndocs = build_corpus_df(big_corpus)
+        py = CiderD(df_mode="corpus", df=df, ref_len=float(ndocs))
+
+        native = NativeCiderD(refs)
+        native.load_df(df, float(ndocs))
+
+        video_ids = list(refs.keys())[:4]
+        rng = np.random.default_rng(5)
+        caps = [" ".join(rng.choice(WORDS, int(rng.integers(3, 9))))
+                for _ in range(8)]
+        got = native.score_strings(video_ids, caps)
+        want = py_score(py, video_ids, caps)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+        # and the external df genuinely changes scores vs the internal one
+        internal = NativeCiderD(refs).score_strings(video_ids, caps)
+        assert not np.allclose(got, internal)
+
+
 class TestEdgeCases:
     def test_empty_hypothesis_scores_zero(self, refs, native_scorer):
         ids = np.zeros((2, 8), dtype=np.int32)

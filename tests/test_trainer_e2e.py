@@ -177,6 +177,42 @@ def test_manet_fusion_stage(data, tmp_path_factory):
     assert res["best_score"] is not None
 
 
+def test_fast_val_with_non_cider_metric(data, tmp_path_factory):
+    """--fast_val must still score the selection metric: selecting on
+    METEOR while fast_val only computed CIDEr used to zero every epoch's
+    score, so best never improved and early stop fired blind."""
+    out = str(tmp_path_factory.mktemp("fastval"))
+    res = run_stage(
+        data, os.path.join(out, "meteor_sel"),
+        **{"--fast_val": ["1"], "--eval_metric": ["METEOR"],
+           "--max_epochs": ["1"]},
+    )
+    val = res["history"]["val"][-1]
+    assert "METEOR" in val, "fast_val dropped the selection metric"
+    assert res["best_score"] == pytest.approx(val["METEOR"])
+    assert res["best_score"] > 0.0, "METEOR selection stuck at zero"
+
+
+def test_unknown_eval_metric_fails_fast(data, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("badmetric"))
+    with pytest.raises(ValueError, match="eval_metric"):
+        run_stage(data, os.path.join(out, "bad"),
+                  **{"--eval_metric": ["SPICE"]})
+
+
+def test_bad_cached_tokens_pickle_fails_loudly(data, tmp_path_factory):
+    """A corrupt --train_cached_tokens must abort the run, not silently
+    train the native scorer on a refs-derived df."""
+    out = str(tmp_path_factory.mktemp("badpkl"))
+    bad = os.path.join(out, "corrupt.pkl")
+    with open(bad, "wb") as f:
+        f.write(b"not a pickle")
+    with pytest.raises(Exception):
+        run_stage(data, os.path.join(out, "cst"),
+                  **{"--use_rl": ["1"], "--train_cached_tokens": [bad],
+                     "--max_epochs": ["1"]})
+
+
 def test_cst_overlap_depths(data, tmp_path_factory):
     """The overlapped reward pipeline (--overlap_rewards k) must drain at
     epoch boundaries: every dispatched rollout gets its grad step, so

@@ -217,6 +217,28 @@ class TestRLStep:
         assert after > before
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_grad_step_policy_is_sampling_policy(self, setup):
+        """The RL gradient must reinforce the SAME policy the rollout
+        sampled from: teacher-forced log-probs recomputed in the grad step
+        (train=False, no dropout) equal the rollout's own per-token
+        log-probs on every supervised position (PARITY.md decision)."""
+        from cst_captioning_tpu.ops.sampling import sample_with_baseline
+        from cst_captioning_tpu.ops.losses import sequence_mask
+
+        model, state, feats, _ = setup
+        sampled, roll_logp, _ = jax.jit(
+            lambda p, f, r: sample_with_baseline(
+                model, {"params": p}, f, r, L, seq_per_img=S)
+        )(state.params, feats, jax.random.PRNGKey(7))
+        logits = model.apply({"params": state.params}, feats, sampled, S,
+                             train=False)
+        recomputed = token_logprobs(logits, sampled)
+        mask = np.asarray(sequence_mask(sampled))
+        np.testing.assert_allclose(
+            np.asarray(roll_logp) * mask, np.asarray(recomputed) * mask,
+            atol=1e-5,
+        )
+
     def test_zero_advantage_no_update(self, setup):
         model, state, feats, _ = setup
         rollout = jax.jit(make_rollout(model, L, S))
