@@ -119,7 +119,7 @@ def synthetic_rewarder(batch: int, seq_per_img: int, vocab_size: int,
         scorer = CiderD(df_mode="corpus", df=df, ref_len=float(n))
     rc = RewardComputer(vocab, scorer, refs, seq_per_img=seq_per_img,
                         baseline="greedy")
-    return rc, list(refs.keys()), scorer_kind
+    return rc, list(refs.keys()), scorer_kind, refs, vocab
 
 
 def bench_xe(args):
@@ -166,7 +166,7 @@ def bench_cst(args):
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
         args.hidden, args.bfloat16,
     )
-    rc, video_ids, scorer_kind = synthetic_rewarder(
+    rc, video_ids, scorer_kind, refs, vocab = synthetic_rewarder(
         args.batch_size, args.seq_per_img, args.vocab,
         native=bool(args.native_cider),
     )
@@ -203,9 +203,31 @@ def bench_cst(args):
     t0 = time.perf_counter()
     state = run_loop(state, 0, args.steps, 200)
     serial = ncaps * args.steps / (time.perf_counter() - t0)
+
+    # Fully-fused on-device reward path (--device_rewards 1): rollout +
+    # CIDEr-D + grad as ONE program, strict on-policy, zero host boundary.
+    from cst_captioning_tpu.training.device_rewards import build_device_tables
+    from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+    corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
+    fused = jax.jit(
+        make_fused_cst_step(model, args.seq_len, args.seq_per_img,
+                            corpus, tables),
+        donate_argnums=(0,),
+    )
+    vix = np.arange(args.batch_size, dtype=np.int32)
+    state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, m = fused(state, feats, vix, jax.random.PRNGKey(301 + i))
+    jax.block_until_ready(m["loss"])
+    fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
+
     return {
         "value": overlapped,
         "serial_captions_per_sec": round(serial, 1),
+        "fused_captions_per_sec": round(fused_cps, 1),
         "overlap_depth": depth,
         "scorer": scorer_kind,
     }
@@ -287,6 +309,7 @@ def run_measurement(args) -> None:
         "xe_captions_per_sec": round(xe, 1),
         "cst_captions_per_sec": round(cst["value"], 1),
         "cst_serial_captions_per_sec": cst["serial_captions_per_sec"],
+        "cst_fused_captions_per_sec": cst["fused_captions_per_sec"],
         "cst_overlap_depth": cst["overlap_depth"],
         "cst_scorer": cst["scorer"],
     }))

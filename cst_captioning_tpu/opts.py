@@ -113,6 +113,11 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                         "serially); k >= 1 overlaps the reward of step t "
                         "with rollouts t+1..t+k, making samples up to k "
                         "updates stale for the grad step (PARITY.md)")
+    g.add_argument("--device_rewards", type=int, default=0,
+                   help="1 = compute CIDEr-D rewards ON DEVICE and fuse the "
+                        "whole CST iteration (rollout+reward+grad) into one "
+                        "XLA program — no host boundary, strict on-policy; "
+                        "0 = host reward path (+ --overlap_rewards pipeline)")
     g.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ CIDEr-D reward scorer (token-id fast path);"
                         " 0 = pure-Python scorer honoring --train_cached_tokens")

@@ -104,11 +104,30 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
                            batch_argnums=(1, 2, 3), donate_argnums=(0,))
     state, rl_metrics = rl(state, feats, sampled, advantage, key)
 
+    # -- fused on-device reward step (--device_rewards) across the mesh ----
+    from cst_captioning_tpu.training.device_rewards import build_device_tables
+    from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+    refs = {
+        f"v{i}": [f"w{1 + (i + j) % (VOCAB - 1)} w{1 + (i * j) % (VOCAB - 1)}"
+                  for j in range(3)]
+        for i in range(B)
+    }
+    corpus, tables, _ = build_device_tables(refs)
+    fused = data_parallel_jit(
+        make_fused_cst_step(model, L, S, corpus, tables), mesh,
+        batch_argnums=(1, 2), donate_argnums=(0,),
+    )
+    video_ix = shard_batch_arrays(mesh, jnp.arange(B, dtype=jnp.int32))
+    state, fused_metrics = fused(state, feats, video_ix, key)
+
     return {
         "mesh_shape": dict(mesh.shape),
         "xe_losses": xe_losses,
         "sampled": sampled_host,
         "greedy": greedy_host,
         "rl_loss": float(rl_metrics["loss"]),
+        "fused_loss": float(fused_metrics["loss"]),
+        "fused_reward": float(fused_metrics["reward"]),
         "params": jax.device_get(state.params),
     }

@@ -1,0 +1,191 @@
+"""Host-side builder for the on-device CIDEr-D tables (ops/jax_ciderd.py).
+
+Runs ONCE at trainer setup: encodes the tokenized training references to
+ids, builds the corpus document-frequency hash table and the dense
+per-video reference TF-IDF tables, and ships them to device memory.  After
+this, the CST reward needs no host at all — ``ops.jax_ciderd.ciderd_scores``
+runs inside the fused train step.
+
+Supports the same df modes as the host scorers:
+- refs-derived corpus df (default), identical to NativeCiderD /
+  build_corpus_df semantics: df = number of videos whose reference set
+  contains the n-gram;
+- an external ``--train_cached_tokens`` pickle (df over word-tuple
+  n-grams): its keys are id-encoded and installed as the table, with all
+  reference n-grams inserted too (df 0 if absent) so hyp<->ref matching
+  still works for n-grams outside the pickle corpus.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.jax_ciderd import MAX_N, PROBES, CorpusTable, RefTables, hash_ngrams_np
+
+
+class _Encoder:
+    """word -> id, extending for OOV reference words (cannot ever match a
+    model-vocab hypothesis id but must still weigh norms/df) — the same
+    scheme as native.NativeCiderD."""
+
+    def __init__(self, word_to_ix: Optional[Mapping[str, int]] = None):
+        self.w2i: Dict[str, int] = dict(word_to_ix or {})
+        self._next = max(self.w2i.values(), default=0) + 1
+
+    def __call__(self, w: str) -> int:
+        ix = self.w2i.get(w)
+        if ix is None:
+            ix = self._next
+            self.w2i[w] = ix
+            self._next += 1
+        return ix
+
+
+def _cook(ids: Sequence[int]) -> Dict[Tuple[int, ...], int]:
+    """Distinct n-grams (1..MAX_N) of an id sequence -> counts."""
+    out: Dict[Tuple[int, ...], int] = {}
+    L = len(ids)
+    for k in range(1, MAX_N + 1):
+        for i in range(L - k + 1):
+            g = tuple(ids[i:i + k])
+            out[g] = out.get(g, 0) + 1
+    return out
+
+
+def _build_hash_table(keys_df: Dict[Tuple[int, ...], float], num_docs: float):
+    """Open-addressed (key1, key2) -> df table with probe length <= PROBES.
+
+    Returns numpy arrays (key1, key2, occupied, df, slot_of) where slot_of
+    maps each n-gram tuple to its table position (the dense 'slot id' used
+    for device-side matching).
+    """
+    n = max(len(keys_df), 1)
+    size = 1 << max(8, math.ceil(math.log2(n * 2 + 1)))
+    while True:
+        key1 = np.zeros(size, np.uint32)
+        key2 = np.zeros(size, np.uint32)
+        occupied = np.zeros(size, bool)
+        df = np.zeros(size, np.float32)
+        slot_of: Dict[Tuple[int, ...], int] = {}
+        ok = True
+        for g, d in keys_df.items():
+            arr = np.asarray(g, np.int64).reshape(1, -1)
+            h1, h2 = hash_ngrams_np(arr, len(g))
+            h1, h2 = int(h1[0]), int(h2[0])
+            pos = h1 % size
+            step = 1 + (h2 % (size - 1))
+            placed = False
+            for _ in range(PROBES):
+                if not occupied[pos]:
+                    key1[pos], key2[pos] = h1, h2
+                    occupied[pos] = True
+                    df[pos] = d
+                    slot_of[g] = pos
+                    placed = True
+                    break
+                if key1[pos] == h1 and key2[pos] == h2:
+                    # genuine duplicate key (or a 64-bit collision, odds
+                    # ~2^-64 per pair): merge df, reuse the slot
+                    df[pos] = max(df[pos], np.float32(d))
+                    slot_of[g] = pos
+                    placed = True
+                    break
+                pos = (pos + step) % size
+            if not placed:
+                ok = False
+                break
+        if ok:
+            return key1, key2, occupied, df, slot_of, float(num_docs)
+        size *= 2  # probe bound exceeded: grow and rebuild
+
+
+def build_device_tables(
+    tokenized_refs: Mapping[str, Sequence[str]],
+    word_to_ix: Optional[Mapping[str, int]] = None,
+    external_df: Optional[Mapping[Tuple[str, ...], float]] = None,
+    external_ref_len: Optional[float] = None,
+) -> Tuple[CorpusTable, RefTables, Dict[str, int]]:
+    """-> (CorpusTable, RefTables, {video_id: row index}) as DEVICE arrays.
+
+    Row order follows ``tokenized_refs`` iteration order; pass an ordered
+    mapping in dataset order so ``Batch.video_ix`` indexes rows directly.
+    """
+    import jax.numpy as jnp
+
+    enc = _Encoder(word_to_ix)
+    cooked = []                       # per video: [(ngram counts, length)]
+    for caps in tokenized_refs.values():
+        refs = []
+        for c in caps:
+            ids = [enc(w) for w in c.split()]
+            refs.append((_cook(ids), len(ids)))
+        cooked.append(refs)
+
+    if external_df is not None:
+        if external_ref_len is None:
+            raise ValueError("external df requires its ref_len (num docs)")
+        keys_df: Dict[Tuple[int, ...], float] = {
+            tuple(enc(w) for w in g): float(d) for g, d in external_df.items()
+        }
+        # reference n-grams outside the pickle corpus still need a slot
+        # (df 0 -> max idf) so hyp<->ref matching keeps working
+        for refs in cooked:
+            for counts, _ in refs:
+                for g in counts:
+                    keys_df.setdefault(g, 0.0)
+        num_docs = float(external_ref_len)
+    else:
+        keys_df = {}
+        for refs in cooked:
+            seen = set()
+            for counts, _ in refs:
+                seen.update(counts.keys())
+            for g in seen:
+                keys_df[g] = keys_df.get(g, 0.0) + 1.0
+        num_docs = float(len(cooked))
+
+    key1, key2, occupied, df, slot_of, num_docs = _build_hash_table(
+        keys_df, num_docs)
+    log_ref_len = math.log(max(num_docs, 1.0))
+
+    V = len(cooked)
+    R = max((len(r) for r in cooked), default=1)
+    G = max((len(c) for refs in cooked for c, _ in refs), default=1)
+    slot = np.full((V, R, G), -1, np.int32)
+    count = np.zeros((V, R, G), np.float32)
+    idf_a = np.zeros((V, R, G), np.float32)
+    order_a = np.zeros((V, R, G), np.int32)
+    norm = np.zeros((V, R, MAX_N), np.float32)
+    length = np.zeros((V, R), np.float32)
+    ref_mask = np.zeros((V, R), np.float32)
+    for v, refs in enumerate(cooked):
+        for r, (counts, rlen) in enumerate(refs):
+            ref_mask[v, r] = 1.0
+            length[v, r] = rlen
+            norm2 = np.zeros(MAX_N)
+            for g_i, (g, c) in enumerate(counts.items()):
+                s = slot_of[g]
+                w_idf = log_ref_len - math.log(max(df[s], 1.0))
+                slot[v, r, g_i] = s
+                count[v, r, g_i] = c
+                idf_a[v, r, g_i] = w_idf
+                order_a[v, r, g_i] = len(g)
+                norm2[len(g) - 1] += (c * w_idf) ** 2
+            norm[v, r] = np.sqrt(norm2)
+
+    corpus = CorpusTable(
+        key1=jnp.asarray(key1), key2=jnp.asarray(key2),
+        occupied=jnp.asarray(occupied), df=jnp.asarray(df),
+        log_ref_len=jnp.asarray(log_ref_len, jnp.float32),
+    )
+    tables = RefTables(
+        slot=jnp.asarray(slot), count=jnp.asarray(count),
+        idf=jnp.asarray(idf_a), order=jnp.asarray(order_a),
+        norm=jnp.asarray(norm), length=jnp.asarray(length),
+        ref_mask=jnp.asarray(ref_mask),
+    )
+    video_row = {vid: i for i, vid in enumerate(tokenized_refs.keys())}
+    return corpus, tables, video_row

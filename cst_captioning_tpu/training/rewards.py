@@ -39,6 +39,15 @@ def decode_sequences(vocab: Vocab, tokens: np.ndarray) -> List[str]:
     return vocab.decode_batch(np.asarray(tokens))
 
 
+def scb_gt_value(scores, scb_captions: int) -> float:
+    """Top-k mean of a video's precomputed consensus scores — the scb-gt
+    baseline value (k = all when scb_captions <= 0).  Shared by the host
+    RewardComputer and the fused device step's baseline table."""
+    s = np.sort(np.asarray(scores, dtype=np.float64))[::-1]
+    k = len(s) if scb_captions <= 0 else min(scb_captions, len(s))
+    return float(s[:k].mean()) if k else 0.0
+
+
 class RewardComputer:
     """Per-batch CIDEr-D rewards + advantage for the CST/REINFORCE stage."""
 
@@ -71,9 +80,7 @@ class RewardComputer:
         self._scb_gt_cache: Dict[str, float] = {}
         if consensus_scores is not None:
             for vid, s in consensus_scores.items():
-                s = np.sort(np.asarray(s, dtype=np.float64))[::-1]
-                k = len(s) if scb_captions <= 0 else min(scb_captions, len(s))
-                self._scb_gt_cache[vid] = float(s[:k].mean()) if k else 0.0
+                self._scb_gt_cache[vid] = scb_gt_value(s, scb_captions)
 
     def _reward(self, video_ids: Sequence[str],
                 token_rows: np.ndarray) -> np.ndarray:

@@ -118,6 +118,89 @@ def make_rollout_fused(model, max_len: int, seq_per_img: int,
     return rollout
 
 
+def make_fused_cst_step(
+    model,
+    max_len: int,
+    seq_per_img: int,
+    corpus,                    # ops.jax_ciderd.CorpusTable (device)
+    tables,                    # ops.jax_ciderd.RefTables (device)
+    baseline: str = "greedy",
+    temperature: float = 1.0,
+    scb_gt_baseline=None,      # (V,) f32 per-video baseline for scb-gt
+) -> Callable:
+    """(state, feats, video_ix, rng) -> (state, metrics): the ENTIRE CST
+    iteration as ONE device program — rollout, on-device CIDEr-D rewards
+    (ops/jax_ciderd.py), advantage, REINFORCE gradient, optimizer update.
+
+    No host boundary, no device->host transfer, no pipeline staleness:
+    this is the fully TPU-native form of the reference's
+    rollout -> get_self_critical_reward -> RewardCriterion loop
+    (SURVEY.md §3.2), enabled with --device_rewards.  ``video_ix`` is the
+    batch's dataset video indices (Batch.video_ix), which index the
+    reference tables directly.
+    """
+    from ..ops.jax_ciderd import ciderd_scores
+
+    if baseline == "scb-gt" and scb_gt_baseline is None:
+        raise ValueError("scb-gt fused step needs the per-video baseline table")
+    if baseline == "scb-sample" and seq_per_img < 2:
+        # same guard as RewardComputer: /(S-1) would be a silent NaN on device
+        raise ValueError("scb-sample baseline needs seq_per_img >= 2")
+
+    def step(state: TrainState, feats, video_ix, rng):
+        variables = {"params": state.params}
+        if baseline == "greedy":
+            sampled, _, greedy = sample_with_baseline(
+                model, variables, feats, rng, max_len,
+                seq_per_img=seq_per_img, temperature=temperature,
+            )
+        else:
+            sampled, _ = sample_captions(
+                model, variables, feats, rng, max_len,
+                seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+            )
+            greedy = None
+        sampled = jax.lax.stop_gradient(sampled)
+        hyp_vix = jnp.repeat(video_ix, seq_per_img)
+        r_sample = ciderd_scores(sampled, hyp_vix, corpus, tables)
+        if baseline == "greedy":
+            r_base = jnp.repeat(
+                ciderd_scores(jax.lax.stop_gradient(greedy), video_ix,
+                              corpus, tables),
+                seq_per_img,
+            )
+        elif baseline == "scb-sample":
+            per_vid = r_sample.reshape(-1, seq_per_img)
+            loo = (per_vid.sum(axis=1, keepdims=True) - per_vid) \
+                / (seq_per_img - 1)
+            r_base = loo.reshape(-1)
+        else:  # scb-gt
+            r_base = jnp.repeat(scb_gt_baseline[video_ix], seq_per_img)
+        advantage = (r_sample - r_base).astype(jnp.float32)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params}, feats, sampled, seq_per_img,
+                train=False,  # same no-dropout decision as make_rl_grad_step
+            )
+            logp = token_logprobs(logits, sampled)
+            return reward_loss(logp, sampled, advantage)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        metrics = {
+            "loss": loss,
+            "grad_norm": _grad_norm(grads),
+            "sample_len": sequence_mask(sampled).sum(axis=1).mean(),
+            "reward": r_sample.mean(),
+            "baseline": r_base.mean(),
+            "advantage": advantage.mean(),
+        }
+        return new_state, metrics
+
+    return step
+
+
 def make_rl_grad_step(model, seq_per_img: int) -> Callable:
     """(state, feats, sampled, advantage, rng) -> (state, metrics).
 

@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 
 from ..data.dataset import CaptionDataset, SplitPaths
 from ..data.loader import CaptionLoader, prefetch_to_device
@@ -271,6 +272,13 @@ class Trainer:
     def _setup_rl(self) -> None:
         opt = self.opt
         refs = tokenize_corpus(self.train_ds.references())
+        self._fused_step = None
+        # Resume-safe rollout key stream: continue from the restored step so
+        # a resumed run never replays the multinomial draws it already used.
+        self._rl_dispatch_step = int(self.state.step)
+        if getattr(opt, "device_rewards", 0):
+            self._setup_fused_rl(refs)
+            return
         scorer = None
         if getattr(opt, "native_cider", 1):
             # C++ scorer consumes token ids straight off the rollout.
@@ -336,9 +344,64 @@ class Trainer:
             lambda ctx, s, g: self.reward_computer(ctx[1], s, g),
             depth=getattr(opt, "overlap_rewards", DEFAULT_OVERLAP_REWARDS),
         )
-        # Resume-safe rollout key stream: continue from the restored step so
-        # a resumed run never replays the multinomial draws it already used.
-        self._rl_dispatch_step = int(self.state.step)
+
+    def _setup_fused_rl(self, refs) -> None:
+        """--device_rewards: the whole CST iteration as ONE device program
+        (rollout + on-device CIDEr-D + REINFORCE grad; steps.py
+        make_fused_cst_step).  No host reward path, no pipeline, strict
+        on-policy semantics."""
+        from .device_rewards import build_device_tables
+        from .rewards import scb_gt_value
+        from .steps import make_fused_cst_step
+
+        opt = self.opt
+        external_df = external_ref_len = None
+        if getattr(opt, "train_cached_tokens", None):
+            external_df, external_ref_len = load_corpus_df(
+                opt.train_cached_tokens)
+        corpus, tables, video_row = build_device_tables(
+            refs, self.vocab.word_to_ix,
+            external_df=external_df, external_ref_len=external_ref_len,
+        )
+        # Batch.video_ix indexes the dataset's video list; the tables were
+        # built from references() which iterates that same list, so rows
+        # must line up exactly.
+        assert all(video_row[vid] == i
+                   for i, vid in enumerate(self.train_ds.video_ids))
+        scb_gt = None
+        if opt.rl_baseline == "scb-gt":
+            if self.consensus_scores is None:
+                raise ValueError("scb-gt baseline needs --train_bcmrscores_pkl")
+            import jax.numpy as jnp
+
+            missing = [v for v in self.train_ds.video_ids
+                       if v not in self.consensus_scores]
+            if missing:
+                # Same visibility as the host path: a mismatched pickle
+                # would otherwise degrade training invisibly (baseline 0).
+                log.warning(
+                    "scb-gt baseline: %d video(s) missing from the "
+                    "consensus pickle (e.g. %s); their baseline falls back "
+                    "to 0.0 — check --train_bcmrscores_pkl matches the "
+                    "training split", len(missing), missing[:3],
+                )
+            scb_gt = jnp.asarray(np.asarray([
+                scb_gt_value(self.consensus_scores.get(vid, [0.0]),
+                             opt.scb_captions)
+                for vid in self.train_ds.video_ids
+            ], dtype=np.float32))
+        self._fused_step = data_parallel_jit(
+            make_fused_cst_step(
+                self.model, opt.max_length, opt.seq_per_img, corpus, tables,
+                baseline=opt.rl_baseline, temperature=opt.temperature,
+                scb_gt_baseline=scb_gt,
+            ),
+            self.mesh, batch_argnums=(1, 2), donate_argnums=(0,),
+        )
+        self._rl_pipeline = None
+        log.info("RL reward: fused on-device CIDEr-D (%d videos, "
+                 "df table %d slots)", tables.ref_mask.shape[0],
+                 corpus.key1.shape[0])
 
     # -- iteration bodies --------------------------------------------------
 
@@ -358,16 +421,25 @@ class Trainer:
         pairs — empty while the pipeline fills.
         """
         roll_rng = jax.random.fold_in(self.rng, self._rl_dispatch_step)
-        ctx = (self._rl_dispatch_step, batch.video_ids)
+        step_ix = self._rl_dispatch_step
         self._rl_dispatch_step += 1
+        if self._fused_step is not None:  # --device_rewards: no host gap
+            self.state, metrics = self._fused_step(
+                self.state, batch.feats,
+                np.asarray(batch.video_ix, dtype=np.int32), roll_rng,
+            )
+            return [(step_ix, metrics)]
         self.state, completed = self._rl_pipeline.push(
-            self.state, batch.feats, roll_rng, self.rng, ctx
+            self.state, batch.feats, roll_rng, self.rng,
+            (step_ix, batch.video_ids),
         )
         return [(c[0], m) for c, m in completed]
 
     def _rl_drain(self):
         """Flush the pipeline (epoch boundary / checkpoint / end of run);
         returns the flushed steps' (step_index, metrics) for logging."""
+        if self._rl_pipeline is None:  # fused path has nothing in flight
+            return []
         self.state, completed = self._rl_pipeline.drain(self.state)
         return [(c[0], m) for c, m in completed]
 

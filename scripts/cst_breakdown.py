@@ -36,7 +36,7 @@ def main():
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
         args.hidden, args.bfloat16,
     )
-    rc, video_ids, scorer_kind = synthetic_rewarder(
+    rc, video_ids, scorer_kind, _, _ = synthetic_rewarder(
         args.batch_size, args.seq_per_img, args.vocab,
         native=not args.python_scorer,
     )

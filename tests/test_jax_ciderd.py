@@ -1,0 +1,220 @@
+"""On-device CIDEr-D (ops/jax_ciderd.py) parity with the Python oracle.
+
+The Python scorer (metrics/ciderd.py) is itself oracle-tested and the C++
+scorer matches it at 1e-9; the device scorer must agree so the fused CST
+step's rewards are interchangeable with the host path.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
+from cst_captioning_tpu.ops.jax_ciderd import ciderd_scores
+from cst_captioning_tpu.training.device_rewards import build_device_tables
+
+WORDS = ["a", "man", "is", "cooking", "dog", "runs", "the", "park",
+         "woman", "sings", "plays", "guitar", "cat", "sleeps"]
+W2I = {w: i + 1 for i, w in enumerate(WORDS)}
+VOCAB = Vocab({i + 1: w for i, w in enumerate(WORDS)})
+
+
+def make_refs(num_videos=8, caps_per_video=4, seed=0):
+    rng = np.random.default_rng(seed)
+    refs = {}
+    for v in range(num_videos):
+        refs[f"v{v}"] = [
+            " ".join(rng.choice(WORDS, int(rng.integers(3, 9))))
+            for _ in range(caps_per_video)
+        ]
+    return refs
+
+
+def py_scores(py_scorer, refs, video_ids, captions):
+    per_vid = len(captions) // len(video_ids)
+    gts, res = {}, []
+    for i, cap in enumerate(captions):
+        key = str(i)
+        gts[key] = list(refs[video_ids[i // per_vid]])
+        res.append({"image_id": key, "caption": [cap]})
+    return py_scorer.compute_score(gts, res)[1]
+
+
+def encode_rows(captions, max_len=12):
+    rows = np.zeros((len(captions), max_len), np.int32)
+    for i, c in enumerate(captions):
+        ids = [W2I[w] for w in c.split()][:max_len]
+        rows[i, :len(ids)] = ids
+    return rows
+
+
+@pytest.fixture(scope="module")
+def setup():
+    refs = make_refs()
+    df, n = build_corpus_df(refs)
+    py = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    corpus, tables, video_row = build_device_tables(refs, W2I)
+    return refs, py, corpus, tables, video_row
+
+
+def test_parity_with_python_scorer(setup):
+    refs, py, corpus, tables, video_row = setup
+    rng = np.random.default_rng(3)
+    video_ids = list(refs.keys())[:4]
+    caps = [" ".join(rng.choice(WORDS, int(rng.integers(2, 10))))
+            for _ in range(8)]
+    rows = encode_rows(caps)
+    vix = np.repeat([video_row[v] for v in video_ids], 2).astype(np.int32)
+    got = np.asarray(jax.jit(ciderd_scores, static_argnames="sigma")(
+        rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_parity_reference_captions_score_high(setup):
+    """A hypothesis equal to one of its own references must score exactly
+    what the Python scorer gives (a high score), including the clipping."""
+    refs, py, corpus, tables, video_row = setup
+    video_ids = list(refs.keys())[:3]
+    caps = [refs[v][0] for v in video_ids]
+    rows = encode_rows(caps)
+    vix = np.asarray([video_row[v] for v in video_ids], np.int32)
+    got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert (got > 1.0).all()
+
+
+def test_empty_and_degenerate_rows(setup):
+    refs, py, corpus, tables, video_row = setup
+    video_ids = list(refs.keys())[:2]
+    caps = ["", "dog dog dog dog dog dog"]
+    rows = encode_rows(caps)
+    vix = np.asarray([video_row[v] for v in video_ids], np.int32)
+    got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert got[0] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_external_df_parity(setup):
+    """--train_cached_tokens path: tables built from a superset-corpus df
+    must match the Python scorer loaded with the same df."""
+    refs, _, _, _, _ = setup
+    big = {**refs, **make_refs(num_videos=20, seed=7)}
+    df, n = build_corpus_df(big)
+    py = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    corpus, tables, video_row = build_device_tables(
+        refs, W2I, external_df=df, external_ref_len=float(n))
+    rng = np.random.default_rng(5)
+    video_ids = list(refs.keys())[:4]
+    caps = [" ".join(rng.choice(WORDS, int(rng.integers(2, 10))))
+            for _ in range(4)]
+    rows = encode_rows(caps)
+    vix = np.asarray([video_row[v] for v in video_ids], np.int32)
+    got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedStep:
+    """The fused on-device CST step must be EQUIVALENT to the host path:
+    same rollout key -> same samples -> same advantages (device scorer vs
+    Python scorer) -> same parameter update."""
+
+    def _build(self):
+        from cst_captioning_tpu.models import CaptionModel
+        from cst_captioning_tpu.training.state import (
+            create_train_state,
+            make_optimizer,
+        )
+
+        refs = make_refs(num_videos=4, caps_per_video=3, seed=2)
+        model = CaptionModel(
+            vocab_size=len(WORDS) + 1, embed_size=16, hidden_size=16,
+            attn_size=16, use_attention=True, dropout_rate=0.5,
+        )
+        tx, _ = make_optimizer(learning_rate=1e-2, grad_clip=5.0)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), [(3, 8)], 8, 2, tx, batch_size=4
+        )
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (4, 3, 8))]
+        return refs, model, state, feats
+
+    def test_matches_host_path_update(self):
+        from cst_captioning_tpu.training.rewards import RewardComputer
+        from cst_captioning_tpu.training.steps import (
+            make_fused_cst_step,
+            make_rl_grad_step,
+            make_rollout_fused,
+        )
+
+        refs, model, state, feats = self._build()
+        corpus, tables, video_row = build_device_tables(refs, W2I)
+        video_ids = list(refs.keys())
+        vix = np.asarray([video_row[v] for v in video_ids], np.int32)
+        key = jax.random.PRNGKey(9)
+
+        fused = jax.jit(make_fused_cst_step(model, 8, 2, corpus, tables))
+        new_fused, m_fused = fused(state, feats, vix, key)
+
+        df, n = build_corpus_df(refs)
+        py = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+        rc = RewardComputer(VOCAB, py, refs, seq_per_img=2)
+        rollout = jax.jit(make_rollout_fused(model, 8, 2))
+        rl_step = jax.jit(make_rl_grad_step(model, 2))
+        sampled, fetch = rollout(state.params, feats, key)
+        fetched = np.asarray(fetch)
+        adv, stats = rc(video_ids, fetched[:8], fetched[8:])
+        new_host, m_host = rl_step(state, feats, sampled, adv, key)
+
+        assert float(m_fused["reward"]) == pytest.approx(
+            stats["reward"], rel=1e-4, abs=1e-5)
+        assert float(m_fused["advantage"]) == pytest.approx(
+            stats["advantage"], rel=1e-4, abs=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(new_fused.params),
+                        jax.tree_util.tree_leaves(new_host.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_scb_sample_baseline(self):
+        from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+        refs, model, state, feats = self._build()
+        corpus, tables, video_row = build_device_tables(refs, W2I)
+        vix = np.asarray([video_row[v] for v in refs], np.int32)
+        fused = jax.jit(make_fused_cst_step(
+            model, 8, 2, corpus, tables, baseline="scb-sample"))
+        new_state, m = fused(state, feats, vix, jax.random.PRNGKey(3))
+        assert np.isfinite(float(m["loss"]))
+        # leave-one-out baselines average to the per-video sample mean
+        assert float(m["baseline"]) == pytest.approx(
+            float(m["reward"]), abs=1e-4)
+
+    def test_scb_gt_baseline(self):
+        from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+        refs, model, state, feats = self._build()
+        corpus, tables, video_row = build_device_tables(refs, W2I)
+        vix = np.asarray([video_row[v] for v in refs], np.int32)
+        base = np.linspace(0.5, 2.0, len(refs)).astype(np.float32)
+        fused = jax.jit(make_fused_cst_step(
+            model, 8, 2, corpus, tables, baseline="scb-gt",
+            scb_gt_baseline=jax.numpy.asarray(base)))
+        _, m = fused(state, feats, vix, jax.random.PRNGKey(3))
+        assert float(m["baseline"]) == pytest.approx(base.mean(), rel=1e-5)
+
+
+def test_large_random_fuzz(setup):
+    """256 random hypotheses across all videos, bulk parity."""
+    refs, py, corpus, tables, video_row = setup
+    rng = np.random.default_rng(11)
+    video_ids = list(refs.keys())
+    caps = [" ".join(rng.choice(WORDS, int(rng.integers(1, 12))))
+            for _ in range(32 * len(video_ids))]
+    rows = encode_rows(caps)
+    vix = np.repeat([video_row[v] for v in video_ids], 32).astype(np.int32)
+    got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)

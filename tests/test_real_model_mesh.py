@@ -34,6 +34,11 @@ class TestRealModelMesh:
         np.testing.assert_array_equal(r1["sampled"], r8["sampled"])
         np.testing.assert_array_equal(r1["greedy"], r8["greedy"])
         np.testing.assert_allclose(r1["rl_loss"], r8["rl_loss"], rtol=1e-5)
+        # The fused on-device-reward step must also be mesh-invariant.
+        np.testing.assert_allclose(r1["fused_loss"], r8["fused_loss"],
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(r1["fused_reward"], r8["fused_reward"],
+                                   rtol=1e-5)
         flat1 = jax.tree.leaves(r1["params"])
         flat8 = jax.tree.leaves(r8["params"])
         assert len(flat1) == len(flat8)

@@ -230,6 +230,35 @@ def test_cst_overlap_depths(data, tmp_path_factory):
         assert res["best_score"] is not None
 
 
+def test_device_rewards_stage(data, tmp_path_factory):
+    """--device_rewards 1: the fused on-device CIDEr-D CST step through the
+    full CLI surface, for every baseline variant."""
+    out = str(tmp_path_factory.mktemp("devrl"))
+    res = run_stage(
+        data, os.path.join(out, "greedy"),
+        **{"--use_rl": ["1"], "--device_rewards": ["1"],
+           "--train_cached_tokens": [data["train"]["cached_tokens"]],
+           "--max_epochs": ["1"]},
+    )
+    assert res["best_score"] is not None
+    assert res["last_step"] == 2
+    res_scb = run_stage(
+        data, os.path.join(out, "scb"),
+        **{"--use_rl": ["1"], "--device_rewards": ["1"],
+           "--rl_baseline": ["scb-sample"], "--seq_per_img": ["4"],
+           "--max_epochs": ["1"]},
+    )
+    assert res_scb["best_score"] is not None
+    res_gt = run_stage(
+        data, os.path.join(out, "scbgt"),
+        **{"--use_rl": ["1"], "--device_rewards": ["1"],
+           "--rl_baseline": ["scb-gt"],
+           "--train_bcmrscores_pkl": [data["train"]["consensus_pkl"]],
+           "--scb_captions": ["2"], "--max_epochs": ["1"]},
+    )
+    assert res_gt["best_score"] is not None
+
+
 def test_scb_sample_stage(data, tmp_path_factory):
     out = str(tmp_path_factory.mktemp("scb"))
     res = run_stage(
