@@ -11,6 +11,7 @@ by ``eval.py`` score identically to the reference workflow.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
@@ -20,6 +21,8 @@ from .ciderd import CiderD
 from .meteor import compute_meteor
 from .rouge import compute_rouge
 from .tokenizer import tokenize_corpus
+
+_warned_meteor = False
 
 
 def load_cocofmt_refs(cocofmt_file: str) -> Dict[str, List[str]]:
@@ -62,6 +65,18 @@ def language_eval(
         for i, b in enumerate(bleus, 1):
             out[f"Bleu_{i}"] = float(b)
     if "METEOR" in scorers:
+        global _warned_meteor
+        if not _warned_meteor:
+            # An approximated METEOR column silently compared against
+            # jar-METEOR literature numbers is worse than a missing one
+            # (VERDICT r2) — say so once, loudly, at scoring time.
+            logging.getLogger("cst_captioning_tpu.metrics").warning(
+                "METEOR here is the pure-Python 2005-algorithm "
+                "approximation (exact+stem matching, no WordNet/paraphrase "
+                "modules) — NOT numerically comparable to meteor-1.5.jar "
+                "numbers from the literature; see metrics/meteor.py"
+            )
+            _warned_meteor = True
         out["METEOR"] = compute_meteor(gts, res)[0]
     if "ROUGE_L" in scorers:
         out["ROUGE_L"] = compute_rouge(gts, res)[0]

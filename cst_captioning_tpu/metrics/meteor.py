@@ -86,28 +86,46 @@ def _porter_stem(word: str) -> str:
 
 
 def _align(hyp: List[str], ref: List[str]) -> Tuple[int, int]:
-    """Greedy two-stage alignment (exact, then stem). Returns (matches, chunks)."""
+    """Two-stage alignment (exact, then stem). Returns (matches, chunks).
+
+    METEOR's alignment objective is most-matches THEN fewest-chunks; the
+    jar beam-searches that.  This aligner approximates the tie-break by
+    preferring, among equally-matching ref candidates, the one adjacent to
+    the previous hypothesis word's match (extending a chunk) over the
+    first available — which resolves the common repeated-word ties
+    ("a ... a ...") the way the fewest-chunks objective would.
+    """
     n = len(hyp)
     hyp_match = [-1] * n           # hyp index -> ref index
+
+    def pick(i: int, candidates: List[int]) -> int:
+        prev = hyp_match[i - 1] if i > 0 else -2
+        for j in candidates:       # extend the previous chunk if possible
+            if j == prev + 1:
+                return j
+        return candidates[0]
+
     ref_used = [False] * len(ref)
     # stage 1: exact
     for i, hw in enumerate(hyp):
-        for j, rw in enumerate(ref):
-            if not ref_used[j] and hw == rw:
-                hyp_match[i] = j
-                ref_used[j] = True
-                break
+        cands = [j for j, rw in enumerate(ref)
+                 if not ref_used[j] and hw == rw]
+        if cands:
+            j = pick(i, cands)
+            hyp_match[i] = j
+            ref_used[j] = True
     # stage 2: stem on the leftovers
     ref_stems = [_porter_stem(r) for r in ref]
     for i, hw in enumerate(hyp):
         if hyp_match[i] >= 0:
             continue
         hs = _porter_stem(hw)
-        for j, rs in enumerate(ref_stems):
-            if not ref_used[j] and hs == rs:
-                hyp_match[i] = j
-                ref_used[j] = True
-                break
+        cands = [j for j, rs in enumerate(ref_stems)
+                 if not ref_used[j] and hs == rs]
+        if cands:
+            j = pick(i, cands)
+            hyp_match[i] = j
+            ref_used[j] = True
     matches = sum(1 for m in hyp_match if m >= 0)
     # chunks: maximal runs contiguous in both hyp and ref
     chunks = 0

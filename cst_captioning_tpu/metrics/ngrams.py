@@ -15,14 +15,19 @@ NGram = Tuple[str, ...]
 NGramCounts = Dict[NGram, int]
 
 
+def precook_tokens(tokens: Sequence, n: int = 4) -> Dict[tuple, int]:
+    """Count all k-grams for k in 1..n of an already-tokenized sequence
+    (words or ids — the one cooking loop every consumer shares)."""
+    counts: Dict[tuple, int] = defaultdict(int)
+    for k in range(1, n + 1):
+        for i in range(len(tokens) - k + 1):
+            counts[tuple(tokens[i : i + k])] += 1
+    return dict(counts)
+
+
 def precook(caption: str, n: int = 4) -> NGramCounts:
     """Count all k-grams for k in 1..n of a whitespace-tokenized caption."""
-    words = caption.split()
-    counts: NGramCounts = defaultdict(int)
-    for k in range(1, n + 1):
-        for i in range(len(words) - k + 1):
-            counts[tuple(words[i : i + k])] += 1
-    return dict(counts)
+    return precook_tokens(caption.split(), n)
 
 
 def cook_refs(refs: Sequence[str], n: int = 4) -> List[NGramCounts]:

@@ -113,7 +113,12 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
                   for j in range(3)]
         for i in range(B)
     }
-    corpus, tables, _ = build_device_tables(refs)
+    # word_to_ix must mirror the token ids the model emits — without it the
+    # encoder would assign ids in encounter order and hyp<->ref matching
+    # would be scrambled.
+    corpus, tables, _ = build_device_tables(
+        refs, {f"w{k}": k for k in range(1, VOCAB)}
+    )
     fused = data_parallel_jit(
         make_fused_cst_step(model, L, S, corpus, tables), mesh,
         batch_argnums=(1, 2), donate_argnums=(0,),

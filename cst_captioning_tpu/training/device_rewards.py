@@ -23,6 +23,7 @@ from typing import Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..metrics.ngrams import precook_tokens
 from ..ops.jax_ciderd import MAX_N, PROBES, CorpusTable, RefTables, hash_ngrams_np
 
 
@@ -45,14 +46,9 @@ class _Encoder:
 
 
 def _cook(ids: Sequence[int]) -> Dict[Tuple[int, ...], int]:
-    """Distinct n-grams (1..MAX_N) of an id sequence -> counts."""
-    out: Dict[Tuple[int, ...], int] = {}
-    L = len(ids)
-    for k in range(1, MAX_N + 1):
-        for i in range(L - k + 1):
-            g = tuple(ids[i:i + k])
-            out[g] = out.get(g, 0) + 1
-    return out
+    """Distinct n-grams (1..MAX_N) of an id sequence -> counts (the shared
+    metrics.ngrams cooking loop, over ids instead of words)."""
+    return precook_tokens(ids, MAX_N)
 
 
 def _build_hash_table(keys_df: Dict[Tuple[int, ...], float], num_docs: float):

@@ -6,11 +6,15 @@ recipe (XE pretrain -> WXE warm-start -> CST fine-tune) chains stages via
 ``--start_from`` pointing at the previous stage's checkpoint dir, exactly
 like the reference Makefile does with best checkpoints.
 
-Device/host split per CST iteration:
-  rollout (jit, sharded)  ->  reward (host strings, CIDEr-D corpus-df)
-  ->  grad step (jit, sharded)
-with the next batch's h5 reads + HBM transfer overlapped by the loader's
-prefetch thread.
+CST iteration, two shapes (flag-selected):
+  host path (default): rollout (jit, sharded) -> reward (host, C++/Py
+    CIDEr-D) -> grad step (jit, sharded), with up to --overlap_rewards
+    rollouts in flight while the host scores (training/pipeline.py);
+  fused path (--device_rewards 1): ONE device program — rollout +
+    on-device CIDEr-D (ops/jax_ciderd.py) + REINFORCE grad — no host
+    boundary, strict on-policy.
+Either way the next batch's h5 reads + HBM transfer are overlapped by the
+loader's prefetch thread.
 """
 
 from __future__ import annotations
@@ -359,15 +363,21 @@ class Trainer:
         if getattr(opt, "train_cached_tokens", None):
             external_df, external_ref_len = load_corpus_df(
                 opt.train_cached_tokens)
+        # Batch.video_ix indexes the dataset's video list, so table rows
+        # must follow that exact order — re-key rather than trusting the
+        # refs mapping's iteration order (a cocofmt file can list
+        # annotations in any order).
+        try:
+            refs = {v: refs[v] for v in self.train_ds.video_ids}
+        except KeyError as e:
+            raise ValueError(
+                f"video {e.args[0]!r} has no reference captions; "
+                "--device_rewards needs references for every training video"
+            ) from None
         corpus, tables, video_row = build_device_tables(
             refs, self.vocab.word_to_ix,
             external_df=external_df, external_ref_len=external_ref_len,
         )
-        # Batch.video_ix indexes the dataset's video list; the tables were
-        # built from references() which iterates that same list, so rows
-        # must line up exactly.
-        assert all(video_row[vid] == i
-                   for i, vid in enumerate(self.train_ds.video_ids))
         scb_gt = None
         if opt.rl_baseline == "scb-gt":
             if self.consensus_scores is None:

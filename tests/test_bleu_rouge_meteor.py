@@ -76,6 +76,17 @@ class TestMeteor:
     def test_no_match(self):
         assert meteor_segment("zz qq", ["a man rides"]) == 0.0
 
+    def test_identical_with_repeated_words_is_one_chunk(self):
+        """Repeated words ('a ... a ...') must not split the alignment:
+        the adjacency tie-break keeps an identical sentence one chunk."""
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        m, chunks = _align("a man rides a horse".split(),
+                           "a man rides a horse".split())
+        assert (m, chunks) == (5, 1)
+        assert meteor_segment("a man rides a horse",
+                              ["a man rides a horse"]) > 0.99
+
     def test_corpus(self):
         res = {"a": ["the cat sat on the mat"], "b": ["a man rides a horse"]}
         mean, scores = compute_meteor(GTS, res)
