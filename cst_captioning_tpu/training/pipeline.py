@@ -80,10 +80,14 @@ class RewardPipeline:
 
     def _complete_one(self, state) -> Tuple[Any, Tuple[Any, Dict[str, float]]]:
         sampled, fetch, feats, step_rng, ctx = self._pending.pop(0)
-        fetched = np.asarray(jax.device_get(fetch))
+        # TraceAnnotations make the host gap legible in a --profile_dir
+        # trace: fetch-wait (device + transfer latency) vs reward compute.
+        with jax.profiler.TraceAnnotation("cst/fetch_wait"):
+            fetched = np.asarray(jax.device_get(fetch))
         n = sampled.shape[0]
         greedy_rows = fetched[n:] if fetched.shape[0] > n else None
-        advantage, stats = self.advantage_fn(ctx, fetched[:n], greedy_rows)
+        with jax.profiler.TraceAnnotation("cst/host_reward"):
+            advantage, stats = self.advantage_fn(ctx, fetched[:n], greedy_rows)
         state, metrics = self.rl_step_fn(
             state, feats, sampled, advantage, step_rng
         )

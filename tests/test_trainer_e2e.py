@@ -59,6 +59,12 @@ def base_args(data, ckpt_dir, **over):
         "--seed": ["0"],
     }
     args.update({k: [str(x) for x in v] for k, v in over.items()})
+    return flatten_argv(args)
+
+
+def flatten_argv(args: dict) -> list:
+    """{--flag: [values]} -> flat argv list (shared by every opts-driven
+    test in this module)."""
     flat = []
     for k, vals in args.items():
         flat.append(k)
@@ -165,6 +171,66 @@ def test_transformer_decoder_stage(data, tmp_path_factory):
         "--beam_size", "2", "--batch_size", "4", "--max_length", "12",
     ])
     assert rc == 0
+
+
+def test_cst_resume_continues_rng_stream(data, tmp_path_factory):
+    """A CST run resumed from a recovery checkpoint must continue the
+    rollout key stream from the restored step, not replay the multinomial
+    draws of steps it already trained on (round-3 resume fix)."""
+    out = str(tmp_path_factory.mktemp("resume"))
+    ckpt = os.path.join(out, "cst")
+    common = {"--use_rl": ["1"], "--save_every_steps": ["1"],
+              "--max_epochs": ["2"]}
+    run_stage(data, ckpt, **{**common, "--max_epochs": ["1"]})  # epoch 1
+
+    from cst_captioning_tpu.opts import parse_opts
+    from cst_captioning_tpu.training.trainer import Trainer
+
+    opt = parse_opts(base_args(data, ckpt, **common))
+    tr = Trainer(opt)
+    try:
+        assert int(tr.state.step) == 2, "resume did not restore step"
+        assert tr._rl_dispatch_step == 2, (
+            "rollout key stream restarted from 0 on resume"
+        )
+        res = tr.train()
+        assert res["last_step"] == 4
+    finally:
+        tr.close()
+
+
+def test_long_feature_stream_transformer(tmp_path_factory):
+    """Config-5 shape check (SURVEY §6): minutes-long feature streams
+    (T=192 frames) through attention-over-time, both decoders, without
+    pooling away the temporal axis."""
+    import json as _json
+
+    root = str(tmp_path_factory.mktemp("anet"))
+    spec = SyntheticSpec(num_videos=4, captions_per_video=2, max_len=12,
+                         feat_dims=(24,), feat_times=(192,))
+    art = generate(root, "train", spec)
+    for model_type in ("lstm", "transformer"):
+        opt_args = {
+            "--train_feat_h5": _json.loads(art["feat_h5"]),
+            "--train_label_h5": [art["label_h5"]],
+            "--train_info_json": [art["info_json"]],
+            "--checkpoint_path": [os.path.join(root, f"ck_{model_type}")],
+            "--batch_size": ["2"], "--seq_per_img": ["2"],
+            "--rnn_size": ["32"], "--input_encoding_size": ["16"],
+            "--att_size": ["16"], "--model_type": [model_type],
+            "--num_heads": ["2"], "--num_tx_layers": ["2"],
+            "--max_epochs": ["1"], "--max_length": ["12"],
+            "--log_every": ["1"], "--seed": ["0"],
+        }
+        from cst_captioning_tpu.opts import parse_opts
+        from cst_captioning_tpu.training.trainer import Trainer
+
+        tr = Trainer(parse_opts(flatten_argv(opt_args)))
+        try:
+            res = tr.train()
+            assert res["last_step"] == 2
+        finally:
+            tr.close()
 
 
 def test_manet_fusion_stage(data, tmp_path_factory):
