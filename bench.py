@@ -206,28 +206,35 @@ def bench_cst(args):
 
     # Fully-fused on-device reward path (--device_rewards 1): rollout +
     # CIDEr-D + grad as ONE program, strict on-policy, zero host boundary.
+    # Imports/table build run OUTSIDE the try so a code regression fails
+    # loudly; only backend execution failures (compile/OOM on an exotic
+    # device) degrade to fused=null without sinking the headline above.
     from cst_captioning_tpu.training.device_rewards import build_device_tables
     from cst_captioning_tpu.training.steps import make_fused_cst_step
 
     corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
-    fused = jax.jit(
-        make_fused_cst_step(model, args.seq_len, args.seq_per_img,
-                            corpus, tables),
-        donate_argnums=(0,),
-    )
-    vix = np.arange(args.batch_size, dtype=np.int32)
-    state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, m = fused(state, feats, vix, jax.random.PRNGKey(301 + i))
-    jax.block_until_ready(m["loss"])
-    fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
+    step_fn = make_fused_cst_step(model, args.seq_len, args.seq_per_img,
+                                  corpus, tables)
+    fused_cps = None
+    try:
+        fused = jax.jit(step_fn, donate_argnums=(0,))
+        vix = np.arange(args.batch_size, dtype=np.int32)
+        state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, m = fused(state, feats, vix, jax.random.PRNGKey(301 + i))
+        jax.block_until_ready(m["loss"])
+        fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
+    except Exception as e:
+        print(f"bench: fused device-reward execution failed ({e!r}); "
+              "reporting fused=null", file=sys.stderr)
 
     return {
         "value": overlapped,
         "serial_captions_per_sec": round(serial, 1),
-        "fused_captions_per_sec": round(fused_cps, 1),
+        "fused_captions_per_sec":
+            None if fused_cps is None else round(fused_cps, 1),
         "overlap_depth": depth,
         "scorer": scorer_kind,
     }
@@ -263,6 +270,41 @@ def parse_args():
     return p.parse_args()
 
 
+TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_TPU_CACHE.json")
+
+
+def _emit(result: dict, args) -> None:
+    """Print the ONE JSON line; persist real-device results to the cache,
+    and on a CPU fallback attach the last cached device measurement
+    (clearly labeled with its timestamp) so a wedged TPU tunnel degrades
+    to 'CPU number + last known TPU number' instead of CPU-only.
+
+    The cache records the measurement's config (stage + shapes); it is
+    only attached when the current run's metric AND config match, so a
+    cached xe-only or different-batch result can never masquerade as
+    comparable to this run's headline."""
+    config = {k: getattr(args, k) for k in
+              ("batch_size", "seq_per_img", "seq_len", "vocab", "hidden")}
+    if result.get("platform") != "cpu":
+        try:
+            with open(TPU_CACHE, "w") as f:
+                json.dump({"measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                           "config": config, "result": result}, f, indent=2)
+        except OSError:
+            pass
+    elif os.path.exists(TPU_CACHE):
+        try:
+            with open(TPU_CACHE) as f:
+                cache = json.load(f)
+            if (cache.get("result", {}).get("metric") == result.get("metric")
+                    and cache.get("config") == config):
+                result = {**result, "last_tpu_result": cache}
+        except (OSError, ValueError):
+            pass
+    print(json.dumps(result))
+
+
 def run_measurement(args) -> None:
     """Measure in THIS process (assumes a live jax backend) and print JSON.
 
@@ -279,29 +321,29 @@ def run_measurement(args) -> None:
     }
     if args.stage == "xe":
         xe = bench_xe(args)
-        print(json.dumps({
+        _emit({
             "metric": "xe_captions_per_sec_per_chip",
             "value": round(xe, 1),
             "vs_baseline": round(xe / BASELINE_CAPTIONS_PER_SEC, 3),
             **common,
-        }))
+        }, args)
         return
     if args.stage == "cst":
         cst = bench_cst(args)
-        print(json.dumps({
+        _emit({
             "metric": "cst_captions_per_sec_per_chip",
             "value": round(cst["value"], 1),
             "vs_baseline": round(cst["value"] / BASELINE_CAPTIONS_PER_SEC, 3),
             **common,
             **{k: v for k, v in cst.items() if k != "value"},
-        }))
+        }, args)
         return
     # default: BOTH stages, headline = the worse of the two, so the driver
     # artifact can never pass on the easy stage alone (VERDICT.md round 2).
     xe = bench_xe(args)
     cst = bench_cst(args)
     worst = min(xe, cst["value"])
-    print(json.dumps({
+    _emit({
         "metric": "min_xe_cst_captions_per_sec_per_chip",
         "value": round(worst, 1),
         "vs_baseline": round(worst / BASELINE_CAPTIONS_PER_SEC, 3),
@@ -312,7 +354,7 @@ def run_measurement(args) -> None:
         "cst_fused_captions_per_sec": cst["fused_captions_per_sec"],
         "cst_overlap_depth": cst["overlap_depth"],
         "cst_scorer": cst["scorer"],
-    }))
+    }, args)
 
 
 def probe_backend(timeout_s: float, retries: int) -> str | None:
