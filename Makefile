@@ -31,7 +31,7 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test xe wxe cst cst_scb eval bench demo clean
+.PHONY: test xe wxe cst cst_scb cst_fused eval bench demo clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -65,6 +65,16 @@ cst_scb:
 	  --train_cached_tokens $(DATA)/train_ciderdf.pkl \
 	  --learning_rate 5e-5 \
 	  --checkpoint_path $(OUT)/$(EXP)_cst_scb
+
+# CST with the reward computed ON DEVICE: the whole iteration is one XLA
+# program (no host reward boundary, strict on-policy) — see --device_rewards.
+cst_fused:
+	$(PY) train.py $(TRAIN_COMMON) \
+	  --start_from $(OUT)/$(EXP)_wxe \
+	  --use_rl 1 --rl_baseline greedy --device_rewards 1 \
+	  --train_cached_tokens $(DATA)/train_ciderdf.pkl \
+	  --learning_rate 5e-5 \
+	  --checkpoint_path $(OUT)/$(EXP)_cst_fused
 
 eval:
 	$(PY) eval.py \
