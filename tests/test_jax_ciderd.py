@@ -206,6 +206,27 @@ class TestFusedStep:
         assert float(m["baseline"]) == pytest.approx(base.mean(), rel=1e-5)
 
 
+def test_oov_reference_words_match_python_scorer():
+    """References containing words OUTSIDE the model vocab must still
+    weigh df and reference norms exactly like the string scorers do
+    (they can never match a hypothesis, whose ids come from the vocab)."""
+    refs = make_refs(num_videos=4, caps_per_video=3, seed=4)
+    refs = {v: caps + [caps[0] + " zzunseen qqrare"]
+            for v, caps in refs.items()}
+    df, n = build_corpus_df(refs)
+    py = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    corpus, tables, video_row = build_device_tables(refs, W2I)  # W2I lacks them
+    rng = np.random.default_rng(6)
+    video_ids = list(refs.keys())
+    caps = [" ".join(rng.choice(WORDS, int(rng.integers(2, 10))))
+            for _ in range(4)]
+    rows = encode_rows(caps)
+    vix = np.asarray([video_row[v] for v in video_ids], np.int32)
+    got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 def test_large_random_fuzz(setup):
     """256 random hypotheses across all videos, bulk parity."""
     refs, py, corpus, tables, video_row = setup

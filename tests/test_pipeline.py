@@ -1,0 +1,94 @@
+"""RewardPipeline unit semantics (training/pipeline.py).
+
+The e2e suite drives the pipeline through the Trainer; these tests pin the
+class contract itself: fill behavior at each depth, completion order,
+ctx passthrough, and drain.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.training.pipeline import RewardPipeline
+
+
+class FakeDevice:
+    """Stand-in device stack: rollout returns tagged arrays; rl_step
+    counts updates into state."""
+
+    def __init__(self):
+        self.rollout_calls = []
+        self.step_calls = []
+
+    def rollout(self, params, feats, rng):
+        self.rollout_calls.append(rng)
+        sampled = np.full((4, 3), rng, np.int32)
+        fetch = np.concatenate([sampled, np.full((2, 3), rng + 100, np.int32)])
+        return sampled, fetch
+
+    def rl_step(self, state, feats, sampled, advantage, rng):
+        self.step_calls.append(int(sampled[0, 0]))
+        new = SimpleNamespace(params=state.params, step=state.step + 1)
+        return new, {"loss": float(advantage.mean())}
+
+
+def advantage_fn(ctx, sampled_rows, greedy_rows):
+    assert sampled_rows.shape == (4, 3)
+    assert greedy_rows.shape == (2, 3)
+    return np.full(4, float(ctx)), {"ctx": float(ctx)}
+
+
+@pytest.mark.parametrize("depth", [0, 1, 3])
+def test_fill_then_steady_state(depth):
+    dev = FakeDevice()
+    pipe = RewardPipeline(dev.rollout, dev.rl_step, advantage_fn, depth)
+    state = SimpleNamespace(params=None, step=0)
+    completed = []
+    for k in range(6):
+        state, done = pipe.push(state, None, k, k, k)
+        assert len(done) <= 1
+        completed += done
+    # first `depth` pushes only fill the queue
+    assert len(completed) == 6 - depth
+    assert len(pipe) == depth
+    state, drained = pipe.drain(state)
+    completed += drained
+    assert len(pipe) == 0
+    # every step completed exactly once, in dispatch order, ctx intact
+    assert [c[0] for c in completed] == list(range(6))
+    assert [c[1]["ctx"] for c in completed] == list(range(6))
+    # grad steps consumed the matching rollout's tokens
+    assert dev.step_calls == list(range(6))
+    assert state.step == 6
+
+
+def test_depth_clamped_non_negative():
+    dev = FakeDevice()
+    pipe = RewardPipeline(dev.rollout, dev.rl_step, advantage_fn, -3)
+    assert pipe.depth == 0
+    state = SimpleNamespace(params=None, step=0)
+    state, done = pipe.push(state, None, 0, 0, 0)
+    assert len(done) == 1  # depth 0 == fully serial
+
+
+def test_scb_fetch_without_greedy_rows():
+    """When fetch == sampled (SCB baselines) the completion must pass
+    greedy_rows=None to the advantage fn."""
+    seen = {}
+
+    def rollout(params, feats, rng):
+        sampled = np.zeros((4, 3), np.int32)
+        return sampled, sampled  # no baseline rows appended
+
+    def adv(ctx, sampled_rows, greedy_rows):
+        seen["greedy"] = greedy_rows
+        return np.zeros(4), {}
+
+    def rl(state, feats, sampled, advantage, rng):
+        return state, {}
+
+    pipe = RewardPipeline(rollout, rl, adv, 0)
+    state = SimpleNamespace(params=None, step=0)
+    pipe.push(state, None, 0, 0, "v")
+    assert seen["greedy"] is None
