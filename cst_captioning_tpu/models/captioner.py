@@ -80,6 +80,11 @@ class CaptionModel(nn.Module):
                 nn.Dense(2 * self.hidden_size, dtype=self.dtype, name=f"state_init_{l}")
                 for l in range(self.num_layers)
             ]
+            # Shared vocab head, hoisted out of the scanned cell: teacher
+            # forcing projects the whole (B, L, H) sequence in ONE batched
+            # GEMM; the samplers apply the same weights per step.
+            self.logit = nn.Dense(self.vocab_size, dtype=self.dtype,
+                                  name="logit")
         elif self.decoder_type == "transformer":
             self.tx = TransformerDecoder(
                 vocab_size=self.vocab_size,
@@ -142,7 +147,9 @@ class CaptionModel(nn.Module):
     ):
         """-> (carry, logits (B, L, V))."""
         if self.decoder_type == "lstm":
-            return self.cell(carry, tokens, memory, proj_mem, pooled, train)
+            carry, h = self.cell(carry, tokens, memory, proj_mem, pooled,
+                                 train)
+            return carry, self.logit(h)
         return self.tx.decode(carry, tokens, memory, pooled, train=train)
 
     # -- teacher-forced training surface -----------------------------------

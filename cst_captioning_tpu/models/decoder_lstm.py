@@ -30,7 +30,14 @@ Carry = Tuple[Tuple[jnp.ndarray, jnp.ndarray], ...]  # ((c, h) per layer)
 
 
 class DecoderCell(nn.Module):
-    """One decode step: embed token, attend, run LSTM stack, emit logits."""
+    """One decode step: embed token, attend, run LSTM stack -> hidden.
+
+    The vocab projection deliberately lives OUTSIDE the cell (in
+    ``CaptionModel``): under ``nn.scan`` an in-cell projection would run L
+    sequential (B, H) x (H, V) GEMMs, while the hoisted head projects the
+    whole (B, L, H) sequence in one batched MXU-friendly GEMM for teacher
+    forcing — and the samplers apply the same shared Dense per step, so
+    training and decoding still share one set of weights/semantics."""
 
     vocab_size: int          # with PAD/EOS row: len(vocab) + 1
     embed_size: int
@@ -72,16 +79,16 @@ class DecoderCell(nn.Module):
         h = inp
         if self.dropout_rate > 0:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
-        logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="logit")(h)
-        return tuple(new_carry), logits
+        return tuple(new_carry), h
 
 
 def scan_decoder(cell_cls=DecoderCell):
-    """nn.scan-transformed DecoderCell: tokens (B, L) -> logits (B, L, V).
+    """nn.scan-transformed DecoderCell: tokens (B, L) -> hiddens (B, L, H).
 
     Params broadcast across time (one weight set), dropout rng split per
     step.  Single-step decoding is the L=1 case of the same transform, so
-    training and sampling can never diverge.
+    training and sampling can never diverge.  The caller applies the
+    shared vocab head to the stacked hiddens (see DecoderCell docstring).
     """
     return nn.scan(
         cell_cls,
