@@ -51,7 +51,7 @@ def main() -> int:
         "--batch_size", "8", "--seq_per_img", "4",
         "--rnn_size", "64", "--input_encoding_size", "32", "--att_size", "32",
         "--max_length", "12", "--drop_prob", "0.2",
-        "--max_epochs", str(args.epochs), "--learning_rate", "0.005",
+        "--max_epochs", str(args.epochs), "--learning_rate", "0.01",
         "--log_every", "2", "--fast_val", "1", "--max_patience", "0",
     ]
 
