@@ -31,7 +31,7 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test xe wxe cst cst_scb cst_fused eval bench demo clean
+.PHONY: test xe wxe cst cst_scb cst_host eval bench demo clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -66,15 +66,18 @@ cst_scb:
 	  --learning_rate 5e-5 \
 	  --checkpoint_path $(OUT)/$(EXP)_cst_scb
 
-# CST with the reward computed ON DEVICE: the whole iteration is one XLA
-# program (no host reward boundary, strict on-policy) — see --device_rewards.
-cst_fused:
+# cst/cst_scb above run the shipped default: reward computed ON DEVICE,
+# the whole iteration one XLA program (--device_rewards 1, strict
+# on-policy).  This target selects the host reward path instead — the
+# reference's serial rollout -> host CIDEr-D -> grad semantics
+# (--overlap_rewards 0; raise it to overlap host scoring with rollouts).
+cst_host:
 	$(PY) train.py $(TRAIN_COMMON) \
 	  --start_from $(OUT)/$(EXP)_wxe \
-	  --use_rl 1 --rl_baseline greedy --device_rewards 1 \
+	  --use_rl 1 --rl_baseline greedy --device_rewards 0 --overlap_rewards 0 \
 	  --train_cached_tokens $(DATA)/train_ciderdf.pkl \
 	  --learning_rate 5e-5 \
-	  --checkpoint_path $(OUT)/$(EXP)_cst_fused
+	  --checkpoint_path $(OUT)/$(EXP)_cst_host
 
 eval:
 	$(PY) eval.py \

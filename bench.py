@@ -6,10 +6,12 @@ Prints ONE JSON line:
 
 By default BOTH stages are measured and the headline value is the MIN of
 the two, so the artifact can't pass on the easy stage alone (--stage xe or
-cst isolates one).  The CST stage runs the shipped trainer configuration:
-native C++ CIDEr-D reward scorer and the overlapped reward pipeline
-(--overlap_depth = trainer's --overlap_rewards default); the strictly
-serial reference-semantics loop is also measured and reported.
+cst isolates one).  The CST stage headlines the shipped trainer
+configuration — the fused on-device reward path (--device_rewards 1,
+rollout + CIDEr-D + grad as ONE XLA program) — and also measures and
+reports the host reward path (native C++ scorer + overlapped pipeline at
+the trainer's --overlap_rewards default) and the strictly serial
+reference-semantics loop.
 
 Baseline: the driver north-star of >= 5000 captions/sec/chip for the XE and
 CST stages on MSR-VTT-shaped data (BASELINE.md; the reference published no
@@ -46,17 +48,20 @@ BASELINE_CAPTIONS_PER_SEC = 5000.0
 
 
 def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
-          hidden: int, use_bfloat16: bool):
+          hidden: int, use_bfloat16: bool, scan_unroll: int | None = None):
     import jax
     import jax.numpy as jnp
 
     from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.opts import DEFAULT_SCAN_UNROLL
     from cst_captioning_tpu.training.state import create_train_state, make_optimizer
 
     model = CaptionModel(
         vocab_size=vocab, embed_size=hidden, hidden_size=hidden,
         attn_size=hidden, use_attention=True, dropout_rate=0.5,
         dtype=jnp.bfloat16 if use_bfloat16 else jnp.float32,
+        scan_unroll=(DEFAULT_SCAN_UNROLL if scan_unroll is None
+                     else scan_unroll),
     )
     tx, _ = make_optimizer(learning_rate=2e-4, grad_clip=10.0)
     feat_shapes = [(28, 2048), (1, 4096)]
@@ -147,15 +152,23 @@ def bench_xe(args):
 
 
 def bench_cst(args):
-    """Full CST iteration throughput in the SHIPPED trainer configuration:
-    C++ CIDEr-D reward scorer (the trainer default; --native_cider 0 for
-    the pure-Python one) and the overlapped reward pipeline
-    (--overlap_depth, default = the trainer's --overlap_rewards default).
-    Also measures the serial (reference-semantics) loop for the report.
+    """Full CST iteration throughput in the SHIPPED trainer configuration.
+
+    The shipped default (--device_rewards 1, opts.DEFAULT_DEVICE_REWARDS)
+    fuses rollout + on-device CIDEr-D + REINFORCE grad into ONE XLA
+    program; that path is the headline CST number.  The host reward path
+    (C++ scorer + overlapped pipeline at the trainer's --overlap_rewards
+    default, plus the strictly serial reference-semantics loop) is always
+    measured and reported alongside — and becomes the headline when
+    --device_rewards 0 is passed or the fused path cannot execute on this
+    backend (then labeled ``cst_path: host_pipeline_fallback``).
     """
     import jax
 
-    from cst_captioning_tpu.opts import DEFAULT_OVERLAP_REWARDS
+    from cst_captioning_tpu.opts import (
+        DEFAULT_DEVICE_REWARDS,
+        DEFAULT_OVERLAP_REWARDS,
+    )
     from cst_captioning_tpu.training.pipeline import RewardPipeline
     from cst_captioning_tpu.training.steps import (
         make_rl_grad_step,
@@ -234,8 +247,21 @@ def bench_cst(args):
         print(f"bench: fused device-reward execution failed ({e!r}); "
               "reporting fused=null", file=sys.stderr)
 
+    want_fused = (args.device_rewards if args.device_rewards is not None
+                  else DEFAULT_DEVICE_REWARDS)
+    if want_fused and fused_cps is not None:
+        value, path = fused_cps, "device_fused"
+    elif want_fused:
+        value, path = overlapped, "host_pipeline_fallback"
+        print("bench: shipped default is --device_rewards 1 but the fused "
+              "path did not execute; CST headline falls back to the host "
+              "pipeline (cst_path=host_pipeline_fallback)", file=sys.stderr)
+    else:
+        value, path = overlapped, "host_pipeline"
     return {
-        "value": overlapped,
+        "value": value,
+        "path": path,
+        "host_pipeline_captions_per_sec": round(overlapped, 1),
         "serial_captions_per_sec": round(serial, 1),
         "fused_captions_per_sec":
             None if fused_cps is None else round(fused_cps, 1),
@@ -261,6 +287,12 @@ def parse_args():
                    help="CST reward-pipeline depth; default = the trainer's "
                         "--overlap_rewards default (read from opts.py); 0 "
                         "benches the strictly serial reference semantics")
+    p.add_argument("--device_rewards", type=int, default=None,
+                   help="which CST path is the headline: default = the "
+                        "trainer's --device_rewards default (read from "
+                        "opts.py, shipped 1 = fused on-device reward); 0 "
+                        "headlines the host reward pipeline.  Both are "
+                        "measured and reported either way")
     p.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ reward scorer (trainer default)")
     p.add_argument("--platform", default="auto", choices=("auto", "device", "cpu"),
@@ -288,10 +320,23 @@ def _emit(result: dict, args) -> None:
     full-bench headline entry) and records every perf-affecting flag; an
     entry is only attached when the current run's metric AND config
     match, so a cached result from a different configuration can never
-    masquerade as comparable to this run's headline."""
+    masquerade as comparable to this run's headline.  The follow-the-
+    trainer-default flags (None) are normalized to their resolved values
+    so `bench.py` and `bench.py --device_rewards 1` — the same measured
+    configuration — share a cache entry."""
+    from cst_captioning_tpu.opts import (
+        DEFAULT_DEVICE_REWARDS,
+        DEFAULT_OVERLAP_REWARDS,
+    )
+
     config = {k: getattr(args, k) for k in
               ("batch_size", "seq_per_img", "seq_len", "vocab", "hidden",
-               "bfloat16", "native_cider", "overlap_depth", "steps")}
+               "bfloat16", "native_cider", "overlap_depth", "device_rewards",
+               "steps")}
+    if config["overlap_depth"] is None:
+        config["overlap_depth"] = DEFAULT_OVERLAP_REWARDS
+    if config["device_rewards"] is None:
+        config["device_rewards"] = DEFAULT_DEVICE_REWARDS
     metric = result.get("metric")
     if result.get("platform") != "cpu":
         cache = {}
@@ -365,6 +410,9 @@ def run_measurement(args) -> None:
         **common,
         "xe_captions_per_sec": round(xe, 1),
         "cst_captions_per_sec": round(cst["value"], 1),
+        "cst_path": cst["path"],
+        "cst_host_pipeline_captions_per_sec":
+            cst["host_pipeline_captions_per_sec"],
         "cst_serial_captions_per_sec": cst["serial_captions_per_sec"],
         "cst_fused_captions_per_sec": cst["fused_captions_per_sec"],
         "cst_overlap_depth": cst["overlap_depth"],

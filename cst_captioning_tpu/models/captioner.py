@@ -56,6 +56,8 @@ class CaptionModel(nn.Module):
     dtype: jnp.dtype = jnp.float32
     use_pallas_attention: bool = False  # fused VMEM attention kernel (lstm)
     fusion_type: str = "temporal"   # "temporal" | "modality" (manet variant)
+    scan_unroll: int = 1            # lax.scan unroll for decoder/sampling
+                                    # scans (see decoder_lstm.scan_decoder)
 
     def setup(self):
         self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
@@ -64,7 +66,7 @@ class CaptionModel(nn.Module):
         if self.decoder_type == "lstm":
             self.memory_proj = nn.Dense(self.attn_size, use_bias=False,
                                         dtype=self.dtype, name="memory_proj")
-            self.cell = scan_decoder()(
+            self.cell = scan_decoder(unroll=self.scan_unroll)(
                 vocab_size=self.vocab_size,
                 embed_size=self.embed_size,
                 hidden_size=self.hidden_size,

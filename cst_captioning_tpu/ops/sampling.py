@@ -71,12 +71,17 @@ def sample_tokens(
     rng: jax.Array,
     greedy=False,
     temperature: float = 1.0,
+    unroll: int = 1,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Roll out ``max_len`` steps from BOS (=0).
 
     ``greedy`` is either a python bool (whole batch) or a per-row (N,) bool
     array — the latter lets one scan carry multinomial rollout rows and
     greedy baseline rows together (``sample_with_baseline``).
+
+    ``unroll`` is forwarded to ``lax.scan`` (see
+    ``models.decoder_lstm.scan_decoder``: same numerics, amortized
+    per-step overhead for small per-step matmuls).
 
     Returns (tokens (N, L) int32 0-terminated, logprobs (N, L) float32 of
     the emitted tokens, 0 past the first EOS).
@@ -109,7 +114,7 @@ def sample_tokens(
         jnp.zeros((batch,), dtype=jnp.int32),        # BOS
         jnp.zeros((batch,), dtype=bool),
     )
-    _, (tokens, logprobs) = jax.lax.scan(body, init, keys)
+    _, (tokens, logprobs) = jax.lax.scan(body, init, keys, unroll=unroll)
     return tokens.T, logprobs.T                       # (L, N) -> (N, L)
 
 
@@ -141,7 +146,8 @@ def sample_captions(
     )
     step = make_decode_step(model, variables, memory, proj_mem, pooled)
     return sample_tokens(step, carry, n, max_len, rng,
-                         greedy=greedy, temperature=temperature)
+                         greedy=greedy, temperature=temperature,
+                         unroll=getattr(model, "scan_unroll", 1))
 
 
 def sample_with_baseline(
@@ -178,6 +184,7 @@ def sample_with_baseline(
     tokens, logprobs = sample_tokens(
         step, carry, ns + b, max_len, rng,
         greedy=greedy_rows, temperature=temperature,
+        unroll=getattr(model, "scan_unroll", 1),
     )
     return tokens[:ns], logprobs[:ns], tokens[ns:]
 

@@ -68,6 +68,12 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--pallas_attention", type=int, default=0,
                    help="1 = fused Pallas VMEM attention kernel in the LSTM "
                         "decoder (interpret-mode off TPU)")
+    g.add_argument("--scan_unroll", type=int, default=DEFAULT_SCAN_UNROLL,
+                   help="decoder-scan unroll factor (teacher forcing + "
+                        "sampling rollout): k steps per lax.scan iteration, "
+                        "identical numerics, amortized per-step overhead.  "
+                        "Default = measured best on TPU (PARITY.md; "
+                        "scripts/unroll_probe.py)")
 
 
 def _add_optim_args(p: argparse.ArgumentParser) -> None:
@@ -87,9 +93,20 @@ def _add_optim_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--seed", type=int, default=123)
 
 
-# Default CST reward-pipeline depth (--overlap_rewards).  bench.py reads
-# this so bare `python bench.py` always measures the shipped configuration.
+# Shipped CST defaults — bench.py reads BOTH so bare `python bench.py`
+# always measures the shipped trainer configuration.
+# DEFAULT_DEVICE_REWARDS = 1: the whole CST iteration runs as ONE XLA
+# program with CIDEr-D computed on device (ops/jax_ciderd.py) — strictly
+# on-policy AND ~2x the throughput of the depth-1 host pipeline on real
+# hardware (PARITY.md measurement table).  --device_rewards 0 selects the
+# host reward path, whose pipeline depth is DEFAULT_OVERLAP_REWARDS.
+DEFAULT_DEVICE_REWARDS = 1
 DEFAULT_OVERLAP_REWARDS = 1
+
+# Decoder-scan unroll (--scan_unroll): measured on TPU v5 lite
+# (scripts/unroll_probe.py, table in PARITY.md); numerics are identical at
+# any value, so this is purely a measured-throughput default.
+DEFAULT_SCAN_UNROLL = 1
 
 
 def _add_cst_args(p: argparse.ArgumentParser) -> None:
@@ -107,17 +124,22 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                    help="multinomial sampling temperature")
     g.add_argument("--overlap_rewards", type=int,
                    default=DEFAULT_OVERLAP_REWARDS,
-                   help="CST pipeline depth: number of rollouts kept in "
-                        "flight while the host scores rewards.  0 = strict "
-                        "reference semantics (rollout -> reward -> grad "
-                        "serially); k >= 1 overlaps the reward of step t "
-                        "with rollouts t+1..t+k, making samples up to k "
-                        "updates stale for the grad step (PARITY.md)")
-    g.add_argument("--device_rewards", type=int, default=0,
-                   help="1 = compute CIDEr-D rewards ON DEVICE and fuse the "
-                        "whole CST iteration (rollout+reward+grad) into one "
-                        "XLA program — no host boundary, strict on-policy; "
-                        "0 = host reward path (+ --overlap_rewards pipeline)")
+                   help="host-path (--device_rewards 0) CST pipeline depth: "
+                        "number of rollouts kept in flight while the host "
+                        "scores rewards.  0 = strict reference semantics "
+                        "(rollout -> reward -> grad serially); k >= 1 "
+                        "overlaps the reward of step t with rollouts "
+                        "t+1..t+k, making samples up to k updates stale for "
+                        "the grad step (PARITY.md).  Ignored under "
+                        "--device_rewards 1 (nothing to overlap)")
+    g.add_argument("--device_rewards", type=int,
+                   default=DEFAULT_DEVICE_REWARDS,
+                   help="1 (default) = compute CIDEr-D rewards ON DEVICE and "
+                        "fuse the whole CST iteration (rollout+reward+grad) "
+                        "into one XLA program — no host boundary, strict "
+                        "on-policy; 0 = host reward path (C++/Python scorer "
+                        "+ --overlap_rewards pipeline), the reference's "
+                        "serial semantics at depth 0")
     g.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ CIDEr-D reward scorer (token-id fast path);"
                         " 0 = pure-Python scorer honoring --train_cached_tokens")

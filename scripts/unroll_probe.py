@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Measure decoder-scan unroll factors on the live device.
+
+The LSTM decode recurrence is sequential: 30 scan steps of small matmuls
+for teacher forcing (XE / RL grad) and for the sampling rollout.  lax.scan
+``unroll=k`` executes k steps per loop iteration so XLA can fuse and
+pipeline across step boundaries.  This probe times the XE step and the
+fused CST step (the two shipped hot loops) at several unroll factors to
+pick the default (opts.DEFAULT_SCAN_UNROLL); results table in PARITY.md.
+
+Model/data scaffolding is imported from bench.py (``build`` /
+``synthetic_rewarder``) so the probe measures exactly the configuration
+the bench headline reports.
+
+Usage: python scripts/unroll_probe.py [--unrolls 1,2,4,8] [--steps 20]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--seq_per_img", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=8000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--bfloat16", type=int, default=1)
+    p.add_argument("--unrolls", default="1,2,4,8")
+    args = p.parse_args()
+
+    import jax
+    import numpy as np
+
+    from bench import build, synthetic_rewarder
+    from cst_captioning_tpu.training.device_rewards import build_device_tables
+    from cst_captioning_tpu.training.steps import make_fused_cst_step, make_xe_step
+
+    print("platform:", jax.devices()[0].platform)
+    ncaps = args.batch_size * args.seq_per_img
+
+    _, _, _, refs, vocab = synthetic_rewarder(
+        args.batch_size, args.seq_per_img, args.vocab)
+    corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
+
+    for unroll in [int(u) for u in args.unrolls.split(",")]:
+        model, state, feats, labels = build(
+            args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+            args.hidden, args.bfloat16, scan_unroll=unroll,
+        )
+        import jax.numpy as jnp
+
+        weights = jnp.ones((ncaps,))
+        vix = np.arange(args.batch_size, dtype=np.int32)
+
+        xe = jax.jit(make_xe_step(model, args.seq_per_img),
+                     donate_argnums=(0,))
+        fused = jax.jit(
+            make_fused_cst_step(model, args.seq_len, args.seq_per_img,
+                                corpus, tables), donate_argnums=(0,))
+
+        t0 = time.perf_counter()
+        state, m = xe(state, feats, labels, weights, jax.random.PRNGKey(0))
+        jax.block_until_ready(m["loss"])
+        xe_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = xe(state, feats, labels, weights,
+                          jax.random.PRNGKey(0))
+        jax.block_until_ready(m["loss"])
+        xe_cps = ncaps * args.steps / (time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        state, m = fused(state, feats, vix, jax.random.PRNGKey(1))
+        jax.block_until_ready(m["loss"])
+        cst_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, m = fused(state, feats, vix, jax.random.PRNGKey(2 + i))
+        jax.block_until_ready(m["loss"])
+        cst_cps = ncaps * args.steps / (time.perf_counter() - t0)
+
+        print(f"unroll {unroll}: xe {xe_cps:,.0f} caps/s "
+              f"(compile {xe_compile:.1f}s) | fused cst {cst_cps:,.0f} "
+              f"caps/s (compile {cst_compile:.1f}s)")
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

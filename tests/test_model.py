@@ -148,3 +148,31 @@ def test_unknown_decoder_type_raises():
         make_model(decoder_type="gru").init(
             jax.random.key(0), FEATS, jnp.zeros((B, L), jnp.int32)
         )
+
+
+def test_scan_unroll_is_pure_performance():
+    """--scan_unroll must not change numerics: same params (the unroll
+    doesn't touch the param tree), same teacher-forced logits, same
+    sampled tokens/logprobs at every factor — including one that doesn't
+    divide the sequence length."""
+    from cst_captioning_tpu.ops.sampling import sample_captions
+
+    labels = jnp.array([[3, 4, 5, 0, 0, 0], [6, 7, 0, 0, 0, 0]])
+    base = make_model(scan_unroll=1)
+    variables = base.init(jax.random.key(0), FEATS, labels)
+    ref_logits = base.apply(variables, FEATS, labels)
+    ref_toks, ref_logp = sample_captions(
+        base, variables, FEATS, jax.random.key(7), L, seq_per_img=2)
+    for unroll in (2, 4):  # 4 does not divide L=6: remainder path covered
+        m = make_model(scan_unroll=unroll)
+        jax.tree_util.tree_map(  # param trees identical
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            variables, m.init(jax.random.key(0), FEATS, labels))
+        np.testing.assert_allclose(
+            np.asarray(m.apply(variables, FEATS, labels)),
+            np.asarray(ref_logits), rtol=1e-6, atol=1e-6)
+        toks, logp = sample_captions(
+            m, variables, FEATS, jax.random.key(7), L, seq_per_img=2)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref_toks))
+        np.testing.assert_allclose(np.asarray(logp), np.asarray(ref_logp),
+                                   rtol=1e-6, atol=1e-6)

@@ -266,17 +266,37 @@ def test_unknown_eval_metric_fails_fast(data, tmp_path_factory):
                   **{"--eval_metric": ["SPICE"]})
 
 
-def test_bad_cached_tokens_pickle_fails_loudly(data, tmp_path_factory):
-    """A corrupt --train_cached_tokens must abort the run, not silently
-    train the native scorer on a refs-derived df."""
+@pytest.mark.parametrize("device_rewards", ["0", "1"])
+def test_bad_cached_tokens_pickle_fails_loudly(data, tmp_path_factory,
+                                               device_rewards):
+    """A corrupt --train_cached_tokens must abort the run on BOTH reward
+    paths, not silently train on a refs-derived df."""
     out = str(tmp_path_factory.mktemp("badpkl"))
     bad = os.path.join(out, "corrupt.pkl")
     with open(bad, "wb") as f:
         f.write(b"not a pickle")
     with pytest.raises(Exception):
         run_stage(data, os.path.join(out, "cst"),
-                  **{"--use_rl": ["1"], "--train_cached_tokens": [bad],
+                  **{"--use_rl": ["1"], "--device_rewards": [device_rewards],
+                     "--train_cached_tokens": [bad],
                      "--max_epochs": ["1"]})
+
+
+def test_default_rl_path_is_fused(data, tmp_path_factory):
+    """The shipped CST default is the fused on-device reward path
+    (opts.DEFAULT_DEVICE_REWARDS = 1): a plain --use_rl 1 run must build
+    the fused step and no host reward pipeline."""
+    out = str(tmp_path_factory.mktemp("defpath"))
+    opt = parse_opts(base_args(data, os.path.join(out, "cst"),
+                               **{"--use_rl": ["1"]}))
+    assert opt.device_rewards == 1
+    tr = Trainer(opt)
+    try:
+        assert tr._fused_step is not None
+        assert tr._rl_pipeline is None
+        assert tr.reward_computer is None
+    finally:
+        tr.close()
 
 
 def test_cst_overlap_depths(data, tmp_path_factory):
@@ -288,7 +308,7 @@ def test_cst_overlap_depths(data, tmp_path_factory):
     for depth in (0, 2):
         res = run_stage(
             data, os.path.join(out, f"d{depth}"),
-            **{"--use_rl": ["1"],
+            **{"--use_rl": ["1"], "--device_rewards": ["0"],
                "--overlap_rewards": [str(depth)],
                "--max_epochs": ["1"]},
         )
@@ -326,10 +346,12 @@ def test_device_rewards_stage(data, tmp_path_factory):
 
 
 def test_scb_sample_stage(data, tmp_path_factory):
+    """Host-path (--device_rewards 0) SCB-sample e2e; the fused-path SCB
+    variants live in test_device_rewards_stage."""
     out = str(tmp_path_factory.mktemp("scb"))
     res = run_stage(
         data, os.path.join(out, "cst_scb"),
-        **{"--use_rl": ["1"],
+        **{"--use_rl": ["1"], "--device_rewards": ["0"],
            "--rl_baseline": ["scb-sample"],
            "--seq_per_img": ["4"],
            "--max_epochs": ["1"]},
@@ -341,7 +363,7 @@ def test_scb_gt_stage(data, tmp_path_factory):
     out = str(tmp_path_factory.mktemp("scbgt"))
     res = run_stage(
         data, os.path.join(out, "cst_scbgt"),
-        **{"--use_rl": ["1"],
+        **{"--use_rl": ["1"], "--device_rewards": ["0"],
            "--rl_baseline": ["scb-gt"],
            "--train_bcmrscores_pkl": [data["train"]["consensus_pkl"]],
            "--scb_captions": ["2"],
