@@ -53,7 +53,7 @@ def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
     import jax.numpy as jnp
 
     from cst_captioning_tpu.models import CaptionModel
-    from cst_captioning_tpu.opts import DEFAULT_SCAN_UNROLL
+    from cst_captioning_tpu.opts import DEFAULT_REMAT_CELL, DEFAULT_SCAN_UNROLL
     from cst_captioning_tpu.training.state import create_train_state, make_optimizer
 
     model = CaptionModel(
@@ -62,6 +62,7 @@ def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
         dtype=jnp.bfloat16 if use_bfloat16 else jnp.float32,
         scan_unroll=(DEFAULT_SCAN_UNROLL if scan_unroll is None
                      else scan_unroll),
+        remat_cell=bool(DEFAULT_REMAT_CELL),
     )
     tx, _ = make_optimizer(learning_rate=2e-4, grad_clip=10.0)
     feat_shapes = [(28, 2048), (1, 4096)]
@@ -141,12 +142,16 @@ def bench_xe(args):
     step = jax.jit(make_xe_step(model, args.seq_per_img), donate_argnums=(0,))
     rng = jax.random.PRNGKey(0)
 
+    # Barriers are VALUE fetches, not block_until_ready: on the remote-TPU
+    # tunnel backend block_until_ready was observed to occasionally return
+    # before execution finished, inflating a loop timing ~20x; fetching the
+    # scalar cannot return early (the value must exist to be returned).
     state, m = step(state, feats, labels, weights, rng)       # compile
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, m = step(state, feats, labels, weights, rng)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])
     dt = time.perf_counter() - t0
     return args.batch_size * args.seq_per_img * args.steps / dt
 
@@ -206,7 +211,7 @@ def bench_cst(args):
         state, done = pipe.drain(state)
         if done:
             last = done[-1]
-        jax.block_until_ready(last[1]["loss"])
+        float(last[1]["loss"])  # value fetch: trustworthy barrier (see bench_xe)
         return state
 
     state = run_loop(state, depth, 2, 0)                       # compile/warm
@@ -237,11 +242,11 @@ def bench_cst(args):
     try:
         del lowered  # compile happens on first call
         state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])
         t0 = time.perf_counter()
         for i in range(args.steps):
             state, m = fused(state, feats, vix, jax.random.PRNGKey(301 + i))
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])  # value fetch: trustworthy barrier (see bench_xe)
         fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
     except Exception as e:
         print(f"bench: fused device-reward execution failed ({e!r}); "
@@ -327,6 +332,8 @@ def _emit(result: dict, args) -> None:
     from cst_captioning_tpu.opts import (
         DEFAULT_DEVICE_REWARDS,
         DEFAULT_OVERLAP_REWARDS,
+        DEFAULT_REMAT_CELL,
+        DEFAULT_SCAN_UNROLL,
     )
 
     config = {k: getattr(args, k) for k in
@@ -337,6 +344,10 @@ def _emit(result: dict, args) -> None:
         config["overlap_depth"] = DEFAULT_OVERLAP_REWARDS
     if config["device_rewards"] is None:
         config["device_rewards"] = DEFAULT_DEVICE_REWARDS
+    # build() bakes these model-level defaults into the measured program,
+    # so they are part of the configuration identity too.
+    config["scan_unroll"] = DEFAULT_SCAN_UNROLL
+    config["remat_cell"] = DEFAULT_REMAT_CELL
     metric = result.get("metric")
     if result.get("platform") != "cpu":
         cache = {}

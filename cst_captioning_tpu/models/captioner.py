@@ -58,6 +58,11 @@ class CaptionModel(nn.Module):
     fusion_type: str = "temporal"   # "temporal" | "modality" (manet variant)
     scan_unroll: int = 1            # lax.scan unroll for decoder/sampling
                                     # scans (see decoder_lstm.scan_decoder)
+    remat_cell: bool = False        # rematerialize the decoder cell in
+                                    # backward: recompute the per-step
+                                    # attention instead of storing (L,B,T,A)
+                                    # f32 residuals (HBM-traffic trade;
+                                    # measured on TPU in PARITY.md)
 
     def setup(self):
         self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
@@ -66,7 +71,13 @@ class CaptionModel(nn.Module):
         if self.decoder_type == "lstm":
             self.memory_proj = nn.Dense(self.attn_size, use_bias=False,
                                         dtype=self.dtype, name="memory_proj")
-            self.cell = scan_decoder(unroll=self.scan_unroll)(
+            # static_argnums counts the bound method's args including the
+            # implicit module/scope slot, so ``train`` (the 6th user arg)
+            # is index 6; it must be static because the cell branches on it
+            cell_cls = (nn.remat(DecoderCell, prevent_cse=False,
+                                 static_argnums=(6,))
+                        if self.remat_cell else DecoderCell)
+            self.cell = scan_decoder(cell_cls, unroll=self.scan_unroll)(
                 vocab_size=self.vocab_size,
                 embed_size=self.embed_size,
                 hidden_size=self.hidden_size,

@@ -68,6 +68,12 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--pallas_attention", type=int, default=0,
                    help="1 = fused Pallas VMEM attention kernel in the LSTM "
                         "decoder (interpret-mode off TPU)")
+    g.add_argument("--remat_cell", type=int, default=DEFAULT_REMAT_CELL,
+                   help="1 (default) = rematerialize the decoder cell in "
+                        "backward: recompute the per-step attention/LSTM "
+                        "instead of storing per-step residuals — less HBM "
+                        "traffic and memory, measured faster on TPU "
+                        "(PARITY.md); 0 = store residuals")
     g.add_argument("--scan_unroll", type=int, default=DEFAULT_SCAN_UNROLL,
                    help="decoder-scan unroll factor (teacher forcing + "
                         "sampling rollout): k steps per lax.scan iteration, "
@@ -107,6 +113,14 @@ DEFAULT_OVERLAP_REWARDS = 1
 # (scripts/unroll_probe.py, table in PARITY.md); numerics are identical at
 # any value, so this is purely a measured-throughput default.
 DEFAULT_SCAN_UNROLL = 1
+
+# Decoder-cell rematerialization (--remat_cell): recompute the per-step
+# attention/LSTM cell in backward instead of storing (L,B,T,A) f32
+# residuals.  On TPU v5 lite this trades trivial recompute FLOPs for
+# ~2GB/step of HBM residual traffic: XE 26.9 -> 21.0 ms/step (+28%),
+# fused CST 52.3 -> 45.8 ms/step (+14%); gradients identical
+# (tests/test_model.py::test_remat_cell_preserves_numerics).
+DEFAULT_REMAT_CELL = 1
 
 
 def _add_cst_args(p: argparse.ArgumentParser) -> None:

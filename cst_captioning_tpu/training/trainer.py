@@ -39,7 +39,11 @@ from ..metrics.ciderd import (
 from ..metrics.consensus import load_consensus, normalize_weights
 from ..metrics.tokenizer import tokenize_corpus
 from ..models.captioner import CaptionModel
-from ..opts import DEFAULT_OVERLAP_REWARDS, DEFAULT_SCAN_UNROLL
+from ..opts import (
+    DEFAULT_OVERLAP_REWARDS,
+    DEFAULT_REMAT_CELL,
+    DEFAULT_SCAN_UNROLL,
+)
 from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
 from .checkpoint import CheckpointManager
@@ -73,6 +77,7 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
         fusion_type={"manet": "modality"}.get(
             getattr(opt, "fusion_type", "temporal"), "temporal"),
         scan_unroll=getattr(opt, "scan_unroll", DEFAULT_SCAN_UNROLL),
+        remat_cell=bool(getattr(opt, "remat_cell", DEFAULT_REMAT_CELL)),
     )
 
 
