@@ -58,6 +58,7 @@ class CaptionLoader:
         process_index: int = 0,
         process_count: int = 1,
         include_gts: bool = False,
+        include_feats: bool = True,
     ):
         self.ds = dataset
         self.batch_size = batch_size
@@ -66,6 +67,10 @@ class CaptionLoader:
         self._rng = np.random.default_rng(seed + process_index)
         self.consensus_weights = consensus_weights
         self.include_gts = include_gts
+        # include_feats=False skips the per-batch h5 feature reads entirely —
+        # the --device_feats path keeps all features resident in HBM and
+        # gathers them by Batch.video_ix inside the train step.
+        self.include_feats = include_feats
         self._refs = dataset.references() if include_gts else None
 
         # Multi-host shard: strided so every process gets an equal slice
@@ -122,7 +127,7 @@ class CaptionLoader:
 
     def next_batch(self) -> Batch:
         ix = self._next_indices(self.batch_size)
-        feats = self.ds.features(ix)
+        feats = self.ds.features(ix) if self.include_feats else []
         labels = np.zeros((self.batch_size * self.seq_per_img, self.ds.seq_length),
                           dtype=np.int32)
         weights = np.ones(self.batch_size * self.seq_per_img, dtype=np.float32)
@@ -169,13 +174,19 @@ class CaptionLoader:
 
 
 def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
-                       device_put=None) -> Iterator[Batch]:
+                       device_put=None, feat_dtype=None) -> Iterator[Batch]:
     """Run batch assembly (h5 reads, numpy packing) in a background thread,
     optionally applying ``device_put`` (e.g. a sharding-aware jax.device_put)
     to feats/labels/weights before handing the batch to the consumer.
 
     This is the TPU replacement for the reference's synchronous get_batch ->
     .cuda() at the call site: HBM transfer of batch t+1 overlaps step t.
+
+    ``feat_dtype`` (e.g. ``ml_dtypes.bfloat16``) casts feature arrays on the
+    HOST before the transfer, halving host->device bytes for bf16 compute —
+    the features are cast to the model dtype on device anyway, so when the
+    model runs bf16 this only moves the (value-preserving) cast before the
+    wire.  Labels/weights are untouched.
     """
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = object()
@@ -193,6 +204,12 @@ def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
     def work():
         try:
             for b in batches:
+                if feat_dtype is not None:
+                    b = Batch(
+                        feats=[np.asarray(f).astype(feat_dtype) for f in b.feats],
+                        labels=b.labels, weights=b.weights,
+                        video_ids=b.video_ids, gts=b.gts, video_ix=b.video_ix,
+                    )
                 if device_put is not None:
                     b = Batch(
                         feats=[device_put(f) for f in b.feats],

@@ -148,6 +148,10 @@ class Trainer:
             # RewardComputer keeps its own tokenized reference corpus, so
             # per-batch gts assembly would be dead work even in RL.
             include_gts=False,
+            # --device_feats: features live in HBM for the whole run and the
+            # train steps gather them by video_ix INSIDE jit, so per-batch
+            # h5 feature reads and host->device feature transfers disappear.
+            include_feats=not bool(getattr(opt, "device_feats", 0)),
         )
         self.val_loader = (
             CaptionLoader(
@@ -211,10 +215,22 @@ class Trainer:
             log.info("resumed from step %d in %s", int(self.state.step),
                      opt.checkpoint_path)
 
+        # -- device-resident features (--device_feats) ---------------------
+        self._feat_tables = None
+        if getattr(opt, "device_feats", 0):
+            self._feat_tables = self._load_device_feats()
+
         # -- compiled steps ------------------------------------------------
+        xe_raw = make_xe_step(self.model, opt.seq_per_img)
+        if self._feat_tables is not None:
+            tables = self._feat_tables
+
+            def xe_raw(state, video_ix, labels, weights, rng, _inner=xe_raw):
+                return _inner(state, [t[video_ix] for t in tables],
+                              labels, weights, rng)
+
         self.xe_step = data_parallel_jit(
-            make_xe_step(self.model, opt.seq_per_img), self.mesh,
-            batch_argnums=(1, 2, 3), donate_argnums=(0,),
+            xe_raw, self.mesh, batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
         self.reward_computer = None
         if opt.use_rl:
@@ -246,7 +262,13 @@ class Trainer:
         pipeline step under RL overlap)."""
         if step1 % self.opt.log_every != 0:
             return
-        m = {k: float(v) for k, v in metrics.items()}
+        # ONE batched device fetch, not a float() per metric: each separate
+        # scalar fetch costs a full host<->device round trip (painful on
+        # remote-TPU tunnels: 6 CST metrics x ~100ms RTT per logged step).
+        for v in metrics.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        m = {k: float(np.asarray(v)) for k, v in metrics.items()}
         lr = float(self.lr_sched(step1 - 1))
         extra = {"lr": lr}
         cps_txt = ""
@@ -276,6 +298,56 @@ class Trainer:
         if self._tb is not None:
             for k, v in metrics.items():
                 self._tb.add_scalar(f"{scope}/{k}", v, step)
+
+    # -- device-resident features -----------------------------------------
+
+    def _feat_dtype(self):
+        """numpy dtype features travel/reside in: bfloat16 when --bf16_feats
+        resolves true (default: follow --use_bfloat16), else None (keep
+        f32).  ONE resolution shared by the streamed prefetch path and the
+        device-resident tables so the two paths can never diverge."""
+        bf16 = getattr(self.opt, "bf16_feats", None)
+        if bf16 is None:
+            bf16 = self.opt.use_bfloat16
+        if not bf16:
+            return None
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+
+    def _load_device_feats(self):
+        """Read EVERY training video's features once and pin them in HBM
+        (replicated over the mesh); train steps gather rows by video_ix
+        inside jit.  Dtype follows ``_feat_dtype`` (bf16 halves residency).
+        MSR-VTT scale is ~0.8 GB in bf16; for datasets that do not fit,
+        leave --device_feats 0 and the prefetch thread streams per-batch
+        features instead.
+
+        Reads in chunks into a preallocated final-dtype array so transient
+        host memory stays ~one chunk per modality, not several full-dataset
+        copies."""
+        from ..parallel import replicated_sharding
+
+        dtype = self._feat_dtype()
+        n = self.train_ds.num_videos
+        shapes = list(zip(self.train_ds.feat_times, self.train_ds.feat_dims))
+        tables_np = [
+            np.empty((n, t, d), dtype or np.float32) for t, d in shapes
+        ]
+        chunk = 512
+        for start in range(0, n, chunk):
+            ix = np.arange(start, min(start + chunk, n))
+            for m, arr in enumerate(self.train_ds.features(ix)):
+                tables_np[m][start:start + len(ix)] = arr
+        tables = [
+            jax.device_put(a, replicated_sharding(self.mesh))
+            for a in tables_np
+        ]
+        total = sum(a.nbytes for a in tables_np)
+        log.info("device_feats: %d videos x %d modalities pinned in HBM "
+                 "(%.2f GB%s)", n, len(tables), total / 1e9,
+                 ", bf16" if dtype is not None else "")
+        return tables
 
     # -- RL plumbing -------------------------------------------------------
 
@@ -331,18 +403,31 @@ class Trainer:
             consensus_scores=self.consensus_scores,
             scb_captions=opt.scb_captions,
         )
+        rollout_raw = make_rollout_fused(
+            self.model, opt.max_length, opt.seq_per_img,
+            temperature=opt.temperature,
+            greedy_baseline=opt.rl_baseline == "greedy")
+        rl_raw = make_rl_grad_step(self.model, opt.seq_per_img)
+        if self._feat_tables is not None:
+            tables = self._feat_tables
+
+            def rollout_raw(params, video_ix, rng, _inner=rollout_raw):
+                return _inner(params, [t[video_ix] for t in tables], rng)
+
+            def rl_raw(state, video_ix, sampled, advantage, rng,
+                       _inner=rl_raw):
+                return _inner(state, [t[video_ix] for t in tables],
+                              sampled, advantage, rng)
+
         self.rollout = data_parallel_jit(
-            make_rollout_fused(self.model, opt.max_length, opt.seq_per_img,
-                               temperature=opt.temperature,
-                               greedy_baseline=opt.rl_baseline == "greedy"),
+            rollout_raw,
             self.mesh, batch_argnums=(1,), donate_argnums=(),
             # sampled flows straight back into rl_step on device, so it must
             # keep the batch sharding; fetch leaves for the host either way.
             out_batch_tree=(True, True),
         )
         self.rl_step = data_parallel_jit(
-            make_rl_grad_step(self.model, opt.seq_per_img), self.mesh,
-            batch_argnums=(1, 2, 3), donate_argnums=(0,),
+            rl_raw, self.mesh, batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
         # Overlapped CST pipeline (SURVEY §7 step 6): rollouts dispatched
         # ahead of their reward/grad step, so host CIDEr-D + the tunnel
@@ -406,14 +491,25 @@ class Trainer:
                              opt.scb_captions)
                 for vid in self.train_ds.video_ids
             ], dtype=np.float32))
-        self._fused_step = data_parallel_jit(
-            make_fused_cst_step(
-                self.model, opt.max_length, opt.seq_per_img, corpus, tables,
-                baseline=opt.rl_baseline, temperature=opt.temperature,
-                scb_gt_baseline=scb_gt,
-            ),
-            self.mesh, batch_argnums=(1, 2), donate_argnums=(0,),
+        fused_raw = make_fused_cst_step(
+            self.model, opt.max_length, opt.seq_per_img, corpus, tables,
+            baseline=opt.rl_baseline, temperature=opt.temperature,
+            scb_gt_baseline=scb_gt,
         )
+        if self._feat_tables is not None:
+            feat_tables = self._feat_tables
+
+            def fused_vix(state, video_ix, rng, _inner=fused_raw):
+                return _inner(state, [t[video_ix] for t in feat_tables],
+                              video_ix, rng)
+
+            self._fused_step = data_parallel_jit(
+                fused_vix, self.mesh, batch_argnums=(1,), donate_argnums=(0,),
+            )
+        else:
+            self._fused_step = data_parallel_jit(
+                fused_raw, self.mesh, batch_argnums=(1, 2), donate_argnums=(0,),
+            )
         self._rl_pipeline = None
         log.info("RL reward: fused on-device CIDEr-D (%d videos, "
                  "df table %d slots)", tables.ref_mask.shape[0],
@@ -421,9 +517,18 @@ class Trainer:
 
     # -- iteration bodies --------------------------------------------------
 
+    def _batch_feats_arg(self, batch):
+        """First batch argument of the compiled steps: the feature arrays
+        (host-streamed path) or the (B,) video indices that gather from the
+        HBM-resident tables inside jit (--device_feats)."""
+        if self._feat_tables is not None:
+            return np.asarray(batch.video_ix, dtype=np.int32)
+        return batch.feats
+
     def _xe_iteration(self, batch) -> Dict[str, float]:
         self.state, metrics = self.xe_step(
-            self.state, batch.feats, batch.labels, batch.weights, self.rng
+            self.state, self._batch_feats_arg(batch), batch.labels,
+            batch.weights, self.rng
         )
         return metrics
 
@@ -440,13 +545,16 @@ class Trainer:
         step_ix = self._rl_dispatch_step
         self._rl_dispatch_step += 1
         if self._fused_step is not None:  # --device_rewards: no host gap
-            self.state, metrics = self._fused_step(
-                self.state, batch.feats,
-                np.asarray(batch.video_ix, dtype=np.int32), roll_rng,
-            )
+            if self._feat_tables is not None:
+                self.state, metrics = self._fused_step(
+                    self.state, self._batch_feats_arg(batch), roll_rng)
+            else:
+                self.state, metrics = self._fused_step(
+                    self.state, batch.feats,
+                    np.asarray(batch.video_ix, dtype=np.int32), roll_rng)
             return [(step_ix, metrics)]
         self.state, completed = self._rl_pipeline.push(
-            self.state, batch.feats, roll_rng, self.rng,
+            self.state, self._batch_feats_arg(batch), roll_rng, self.rng,
             (step_ix, batch.video_ids),
         )
         return [(c[0], m) for c, m in completed]
@@ -489,6 +597,7 @@ class Trainer:
         it = iter(prefetch_to_device(
             iter(self.loader), size=2,
             device_put=lambda x: jax.device_put(x, self._batch_sharding),
+            feat_dtype=self._feat_dtype(),
         ))
         start_step = int(self.state.step)
         total_steps = opt.max_epochs * bpe

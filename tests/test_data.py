@@ -174,6 +174,23 @@ class TestLoader:
         b = next(it)
         assert isinstance(b.labels, jnp.ndarray)
 
+    def test_prefetch_feat_dtype_casts_feats_only(self, ds):
+        """--bf16_feats: features are cast on the host before the transfer
+        (half the wire bytes); labels/weights keep their exact dtypes."""
+        import ml_dtypes
+
+        ref = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=5)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=5)
+        it = prefetch_to_device(iter(loader), feat_dtype=ml_dtypes.bfloat16)
+        a, b = ref.next_batch(), next(it)
+        for fa, fb in zip(a.feats, b.feats):
+            assert fb.dtype == ml_dtypes.bfloat16
+            np.testing.assert_allclose(
+                fa, fb.astype(np.float32), rtol=1e-2, atol=1e-2)
+        assert b.labels.dtype == np.int32
+        assert b.weights.dtype == np.float32
+        np.testing.assert_array_equal(a.labels, b.labels)
+
 
 class TestPrepro:
     def test_cli_roundtrip(self, tmp_path):

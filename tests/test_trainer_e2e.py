@@ -282,6 +282,34 @@ def test_bad_cached_tokens_pickle_fails_loudly(data, tmp_path_factory,
                      "--max_epochs": ["1"]})
 
 
+def test_device_feats_training_is_identical(data, tmp_path_factory):
+    """--device_feats pins features in HBM and gathers by video_ix inside
+    jit; with the same seed (f32, no host casting) it must produce exactly
+    the training trajectory of the host-streamed path — XE and fused CST."""
+    out = str(tmp_path_factory.mktemp("devfeats"))
+
+    def run(tag, extra):
+        opt = parse_opts(base_args(
+            data, os.path.join(out, tag),
+            **{"--max_epochs": ["1"], **extra}))
+        tr = Trainer(opt)
+        try:
+            tr.train()
+            return jax.tree_util.tree_map(np.asarray, tr.state.params)
+        finally:
+            tr.close()
+
+    import jax
+
+    for stage_args in ({}, {"--use_rl": ["1"]}):
+        host = run("host" + ("rl" if stage_args else ""),
+                   {**stage_args, "--device_feats": ["0"]})
+        dev = run("dev" + ("rl" if stage_args else ""),
+                  {**stage_args, "--device_feats": ["1"]})
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b), host, dev)
+
+
 def test_default_rl_path_is_fused(data, tmp_path_factory):
     """The shipped CST default is the fused on-device reward path
     (opts.DEFAULT_DEVICE_REWARDS = 1): a plain --use_rl 1 run must build
