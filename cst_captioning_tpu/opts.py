@@ -36,6 +36,12 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
                    help="0 = use --batch_size")
     g.add_argument("--seq_per_img", type=int, default=20,
                    help="captions per video per batch")
+    g.add_argument("--compile_cache_dir",
+                   default="~/.cache/cst_captioning_tpu/xla",
+                   help="JAX persistent compilation cache directory: repeat "
+                        "CLI invocations (stage chains, eval after train) "
+                        "reuse compiled programs instead of paying 20-40s "
+                        "per program on TPU.  '' disables")
     g.add_argument("--device_feats", type=int, default=0,
                    help="1 = pin EVERY training video's features in device "
                         "HBM once (replicated over the mesh) and gather "

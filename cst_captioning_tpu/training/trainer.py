@@ -328,6 +328,10 @@ class Trainer:
         copies."""
         from ..parallel import replicated_sharding
 
+        if getattr(self.opt, "preload_feats", 0):
+            log.info("--preload_feats with --device_feats keeps an unused "
+                     "full f32 feature copy in host RAM; prefer "
+                     "--preload_feats 0 when features live on device")
         dtype = self._feat_dtype()
         n = self.train_ds.num_videos
         shapes = list(zip(self.train_ds.feat_times, self.train_ds.feat_dims))

@@ -70,6 +70,9 @@ def main(argv=None) -> int:
         level=getattr(logging, opt.loglevel.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    from cst_captioning_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
     paths = SplitPaths(
         feat_h5=list(opt.test_feat_h5),
         label_h5=opt.test_label_h5,

@@ -301,11 +301,15 @@ def test_device_feats_training_is_identical(data, tmp_path_factory):
 
     import jax
 
-    for stage_args in ({}, {"--use_rl": ["1"]}):
-        host = run("host" + ("rl" if stage_args else ""),
-                   {**stage_args, "--device_feats": ["0"]})
-        dev = run("dev" + ("rl" if stage_args else ""),
-                  {**stage_args, "--device_feats": ["1"]})
+    stages = (
+        ("xe", {}),
+        ("fused", {"--use_rl": ["1"]}),
+        # host-reward pipeline: rollout/grad consume the video-ix wrappers
+        ("hostrl", {"--use_rl": ["1"], "--device_rewards": ["0"]}),
+    )
+    for tag, stage_args in stages:
+        host = run(f"host_{tag}", {**stage_args, "--device_feats": ["0"]})
+        dev = run(f"dev_{tag}", {**stage_args, "--device_feats": ["1"]})
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_array_equal(a, b), host, dev)
 

@@ -21,6 +21,7 @@ import sys
 from cst_captioning_tpu.opts import parse_opts
 from cst_captioning_tpu.parallel.dp import distributed_init
 from cst_captioning_tpu.training.trainer import Trainer
+from cst_captioning_tpu.utils.platform import enable_compile_cache
 
 
 def main(argv=None) -> int:
@@ -29,6 +30,7 @@ def main(argv=None) -> int:
         level=getattr(logging, opt.loglevel.upper(), logging.INFO),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
     distributed_init(opt.coordinator_address,
                      opt.num_processes or None, opt.process_id)
     trainer = Trainer(opt)
