@@ -100,13 +100,63 @@ def tokenize_to_str(caption: str) -> str:
     return " ".join(tokenize(caption))
 
 
-def tokenize_corpus(captions_for_key: Dict[str, Iterable[str]]) -> Dict[str, List[str]]:
+_native_batch = None  # resolved lazily: callable, or False if unavailable
+
+
+def _resolve_native():
+    """Load the C++ tokenizer twin (native/tokenizer.cpp) once per process;
+    any build/load failure pins the pure-Python path."""
+    global _native_batch
+    if _native_batch is None:
+        try:
+            from ..native import ptb_tokenize_batch
+
+            # Self-check on representative captions before trusting it.
+            probe = ["A man... isn't (really) cooking the dogs' dinner.",
+                     "cannot. u.s. 'tis \"quoted\"!"]
+            if ptb_tokenize_batch(probe) != [tokenize_to_str(p) for p in probe]:
+                raise RuntimeError("native tokenizer parity probe failed")
+            _native_batch = ptb_tokenize_batch
+        except Exception:
+            _native_batch = False
+    return _native_batch
+
+
+def tokenize_corpus(captions_for_key: Dict[str, Iterable[str]],
+                    use_native: bool = True) -> Dict[str, List[str]]:
     """Tokenize a ``{key: [caption, ...]}`` mapping (coco-caption's interface).
 
     Returns ``{key: [tokenized_caption_str, ...]}`` preserving order, which is
     the exact shape PTBTokenizer.tokenize() returned to COCOEvalCap.
+
+    Bulk calls (the trainer tokenizes every training caption at startup,
+    ``language_eval`` every prediction) go through the C++ twin
+    (``native/tokenizer.cpp``, parity-pinned by
+    tests/test_native_tokenizer.py) in ONE batched call for the ASCII
+    captions; non-ASCII captions and toolchain-less environments fall back
+    to this module per caption.
     """
-    return {
-        key: [tokenize_to_str(c) for c in caps]
-        for key, caps in captions_for_key.items()
+    native = _resolve_native() if use_native else False
+    # Materialize once: the declared contract is Iterable[str], so each
+    # value may be a one-shot generator.
+    corpus = {key: list(caps) for key, caps in captions_for_key.items()}
+    if not native:
+        return {
+            key: [tokenize_to_str(c) for c in caps]
+            for key, caps in corpus.items()
+        }
+    out = {
+        key: [None if c.isascii() else tokenize_to_str(c) for c in caps]
+        for key, caps in corpus.items()
     }
+    # One flat batch across every key for the ASCII captions.
+    flat_keys: List[tuple] = []
+    flat: List[str] = []
+    for key, caps in corpus.items():
+        for j, c in enumerate(caps):
+            if out[key][j] is None:
+                flat_keys.append((key, j))
+                flat.append(c)
+    for (key, j), tok in zip(flat_keys, native(flat)):
+        out[key][j] = tok
+    return out

@@ -34,17 +34,17 @@ class NativeUnavailable(RuntimeError):
     """Raised when the shared library cannot be built/loaded."""
 
 
-def _build() -> None:
+def _build(src: str = _SRC, lib_path: str = _LIB) -> None:
     # No -march=native: the .so is cached on disk and a host-specific ISA
     # would SIGILL (uncatchable) if the cache ever moved between machines.
     # Build to a per-process temp name + rename so concurrent processes
     # (multi-host shared storage, parallel test workers) never load a
     # half-written library.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _LIB)
+        os.replace(tmp, lib_path)
     except FileNotFoundError as e:
         raise NativeUnavailable("g++ not available") from e
     except subprocess.CalledProcessError as e:
@@ -54,19 +54,23 @@ def _build() -> None:
             os.unlink(tmp)
 
 
+def _build_if_stale(src: str, lib_path: str) -> ctypes.CDLL:
+    if (not os.path.exists(lib_path)
+            or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+        _build(src, lib_path)
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError as e:
+        raise NativeUnavailable(f"cannot load {lib_path}: {e}") from e
+
+
 def load_library() -> ctypes.CDLL:
     """Compile (if stale) and load libciderd.so; cached per process."""
     global _loaded
     with _LOCK:
         if _loaded is not None:
             return _loaded
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            _build()
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError as e:
-            raise NativeUnavailable(f"cannot load {_LIB}: {e}") from e
+        lib = _build_if_stale(_SRC, _LIB)
         lib.ciderd_new.restype = ctypes.c_void_p
         lib.ciderd_new.argtypes = [ctypes.c_int, ctypes.c_double]
         lib.ciderd_free.argtypes = [ctypes.c_void_p]
@@ -264,3 +268,79 @@ class NativeCiderD:
             self.close()
         except Exception:
             pass
+
+
+# -- native PTB tokenizer (tokenizer.cpp) ---------------------------------
+
+_TOK_SRC = os.path.join(_DIR, "tokenizer.cpp")
+_TOK_LIB = os.path.join(_DIR, "libptbtok.so")
+_tok_loaded: Optional[ctypes.CDLL] = None
+
+
+def load_tokenizer_library() -> ctypes.CDLL:
+    """Compile (if stale) and load libptbtok.so; cached per process."""
+    global _tok_loaded
+    with _LOCK:
+        if _tok_loaded is not None:
+            return _tok_loaded
+        lib = _build_if_stale(_TOK_SRC, _TOK_LIB)
+        lib.ptb_tokenize.restype = ctypes.c_int
+        lib.ptb_tokenize.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ptb_tokenize_batch.restype = ctypes.c_int
+        lib.ptb_tokenize_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ]
+        _tok_loaded = lib
+        return lib
+
+
+def ptb_tokenize_str(caption: str) -> str:
+    """C++ twin of ``metrics.tokenizer.tokenize_to_str`` for ASCII input.
+
+    Raises NativeUnavailable if the library cannot build/load and
+    ValueError for non-ASCII input (unicode case folding needs the Python
+    path) — callers fall back to the Python tokenizer either way.
+    """
+    if not caption.isascii():
+        raise ValueError("native tokenizer is ASCII-only")
+    lib = load_tokenizer_library()
+    raw = caption.encode("ascii")
+    cap = max(2 * len(raw) + 64, 256)
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.ptb_tokenize(raw, buf, cap)
+    if n < 0:  # output larger than 2x input cannot happen by construction
+        raise NativeUnavailable("tokenizer output buffer overflow")
+    return buf.raw[:n].decode("ascii")
+
+
+def ptb_tokenize_batch(captions: Sequence[str]) -> List[str]:
+    """Batch form of ``ptb_tokenize_str``: one C call for the whole list
+    (the corpus-tokenization hot path makes one call per run instead of
+    one per caption).  ASCII-only; raises like the scalar form."""
+    if not captions:
+        return []
+    encoded = []
+    for c in captions:
+        if not c.isascii():
+            raise ValueError("native tokenizer is ASCII-only")
+        encoded.append(c.encode("ascii"))
+    lib = load_tokenizer_library()
+    offs = np.zeros(len(encoded) + 1, dtype=np.int32)
+    np.cumsum([len(e) for e in encoded], out=offs[1:])
+    blob = b"".join(encoded)
+    cap = max(2 * len(blob) + 64 * len(encoded), 256)
+    out = ctypes.create_string_buffer(cap)
+    out_offs = np.zeros(len(encoded) + 1, dtype=np.int32)
+    n = lib.ptb_tokenize_batch(
+        blob, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(encoded), out, cap,
+        out_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if n < 0:
+        raise NativeUnavailable("tokenizer output buffer overflow")
+    raw = out.raw
+    return [raw[out_offs[i]:out_offs[i + 1]].decode("ascii")
+            for i in range(len(encoded))]

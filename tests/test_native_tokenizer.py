@@ -1,0 +1,152 @@
+"""C++ PTB tokenizer (native/tokenizer.cpp) parity vs the Python oracle.
+
+The native tokenizer replaces the reference's Java PTBTokenizer subprocess
+for bulk corpus paths; metrics/tokenizer.py stays the oracle.  Parity must
+be token-for-token on everything the native path can receive (ASCII) —
+any divergence would silently shift every metric downstream.
+"""
+
+import random
+import string
+
+import pytest
+
+from cst_captioning_tpu.metrics.tokenizer import (
+    tokenize_corpus,
+    tokenize_to_str,
+)
+
+try:
+    from cst_captioning_tpu.native import NativeUnavailable, ptb_tokenize_str
+
+    try:
+        ptb_tokenize_str("probe")
+        NATIVE = True
+    except NativeUnavailable:
+        NATIVE = False
+except ImportError:  # pragma: no cover
+    NATIVE = False
+
+pytestmark = pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+
+GOLDEN = [
+    "A man is cooking.",
+    "a woman is playing in the park",
+    "don't run!",
+    "DON'T RUN!!",
+    "cannot.",
+    "cannot", "gonna", "gotta", "wanna", "lemme", "gimme", "d'ye",
+    "'tis gonna rain", "'twas the night",
+    "the dog... ran (fast)",
+    "it's the dogs' ball",
+    "the child's toy, and the cats' bowls",
+    "u.s. army",
+    "e.g. a dog",
+    "...", "--", "-", "''", "``",
+    "a-b c--d e---f",
+    "he said \"hello there\" loudly",
+    "score: 3/4 (75%)",
+    "x's y're z've w'll v'm u'd tn't",
+    "'quoted' ''double'' '''triple'''",
+    "trailing. .leading .both.",
+    "a.", "a.b.", "A.B.C.",
+    "[brackets] {braces} <angles>",
+    "semi;colon and co:lon",
+    "multi   spaces\tand\nnewlines",
+    "",
+    "   ",
+    "!!!???",
+    "can't won't shouldn't couldn't it'll they're we've i'm you'd",
+]
+
+
+def test_golden_parity():
+    for c in GOLDEN:
+        assert ptb_tokenize_str(c) == tokenize_to_str(c), repr(c)
+
+
+def test_fuzz_parity_caption_like():
+    """Random caption-shaped ASCII strings: words, contractions, punct."""
+    rng = random.Random(0)
+    words = ["a", "man", "is", "cooking", "dog's", "don't", "cannot",
+             "the", "u.s.", "it's", "runs", "fast", "...", "--", "(", ")",
+             "ball,", "park.", "!", "?", "'quoted'", "x", "gonna", "I'm",
+             "they'll", "we've", "isn't", '"say"', "end."]
+    for _ in range(500):
+        c = " ".join(rng.choices(words, k=rng.randint(0, 12)))
+        assert ptb_tokenize_str(c) == tokenize_to_str(c), repr(c)
+
+
+def test_fuzz_parity_raw_ascii():
+    """Adversarial: arbitrary printable-ASCII soup must still agree."""
+    rng = random.Random(1)
+    alphabet = (string.ascii_letters + string.digits
+                + " .',!?-()\"'&%$#@\x1c\x1e\t\n")
+    for _ in range(500):
+        c = "".join(rng.choices(alphabet, k=rng.randint(0, 60)))
+        assert ptb_tokenize_str(c) == tokenize_to_str(c), repr(c)
+
+
+def test_fuzz_parity_contraction_chains():
+    """Dense random chains of contraction suffixes and letters — the
+    left-to-right non-overlap semantics of re.sub must match exactly."""
+    rng = random.Random(2)
+    parts = ["'ll", "'re", "'ve", "n't", "'s", "'m", "'d", "a", "b", "'",
+             "t", "n", "ca", "do"]
+    for _ in range(800):
+        c = "".join(rng.choices(parts, k=rng.randint(1, 8)))
+        assert ptb_tokenize_str(c) == tokenize_to_str(c), repr(c)
+
+
+def test_non_ascii_rejected_and_corpus_falls_back():
+    with pytest.raises(ValueError):
+        ptb_tokenize_str("café au lait")
+    # tokenize_corpus routes non-ASCII through the Python oracle.
+    out = tokenize_corpus({"v": ["café — au lait", "a man runs."]})
+    assert out["v"][0] == tokenize_to_str("café — au lait")
+    assert out["v"][1] == tokenize_to_str("a man runs.")
+
+
+def test_corpus_native_matches_python():
+    caps = {f"v{i}": [c for c in GOLDEN if c.strip()][i::4]
+            for i in range(4)}
+    assert tokenize_corpus(caps, use_native=True) == \
+        tokenize_corpus(caps, use_native=False)
+
+
+def test_long_caption_buffer():
+    c = " ".join(["supercalifragilistic don't"] * 200)
+    assert ptb_tokenize_str(c) == tokenize_to_str(c)
+
+
+def test_review_found_divergences():
+    """Regression pins for the empirically-found parity breaks: chained
+    contractions (re.sub resumes after the consumed group-1 letter),
+    literal lowercase bracket tags (kept by the oracle — the punctuation
+    set holds uppercase only), and Python str.split's \\x1c-\\x1f
+    whitespace that C isspace misses."""
+    cases = [
+        "can't've", "don't've", "isn't's", "y'all'll", "does's'm",
+        "-lrb-", "-LrB-", "-LRB-", "(",
+        "a\x1cb", "a\x1db c\x1ed", "x\x1fy",
+    ]
+    for c in cases:
+        assert ptb_tokenize_str(c) == tokenize_to_str(c), repr(c)
+
+
+def test_corpus_accepts_generators():
+    """tokenize_corpus's values are Iterable[str]: one-shot generators
+    must tokenize completely (the native path once consumed them twice)."""
+    caps = ["a man runs.", "café au lait", "don't stop"]
+    out = tokenize_corpus({"v": (c for c in caps)})
+    assert out["v"] == [tokenize_to_str(c) for c in caps]
+
+
+def test_batch_matches_scalar():
+    from cst_captioning_tpu.native import ptb_tokenize_batch
+
+    caps = [c for c in GOLDEN]
+    assert ptb_tokenize_batch(caps) == [ptb_tokenize_str(c) for c in caps]
+    assert ptb_tokenize_batch([]) == []
+    with pytest.raises(ValueError):
+        ptb_tokenize_batch(["ok", "café"])
