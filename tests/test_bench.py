@@ -1,0 +1,73 @@
+"""Driver-artifact contract test: bare ``python bench.py`` must emit ONE
+parseable JSON line with the schema the driver and the judge consume
+(metric/value/vs_baseline/unit + both stages + the cst path label).
+
+Runs the real CLI in a subprocess on the host CPU with tiny shapes — this
+pins the artifact format, not performance."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.e2e
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TINY = ["--batch_size", "2", "--seq_per_img", "2", "--seq_len", "8",
+        "--vocab", "60", "--hidden", "16", "--steps", "2",
+        "--platform", "cpu"]
+
+
+def run_bench(*extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    # Output to temp FILES, not pipes: bench's measurement child runs in
+    # its own session and would keep inherited pipes open past a timeout
+    # kill, turning the post-timeout drain into a second unbounded hang
+    # (the hazard bench.py's probe_backend docstring documents).
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out, \
+            tempfile.TemporaryFile("w+") as err:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), *TINY, *extra],
+            stdout=out, stderr=err, text=True, timeout=900, cwd=REPO,
+            env=env,
+        )
+        out.seek(0)
+        err.seek(0)
+        stdout, stderr = out.read(), err.read()
+    assert proc.returncode == 0, stderr[-2000:]
+    lines = [l for l in stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_default_emits_both_stages():
+    out = run_bench()
+    assert out["metric"] == "min_xe_cst_captions_per_sec_per_chip"
+    assert out["unit"] == "captions/s/chip"
+    assert out["platform"] == "cpu"
+    assert out["value"] > 0
+    assert out["vs_baseline"] == pytest.approx(out["value"] / 5000.0,
+                                               abs=0.0015)
+    assert out["xe_captions_per_sec"] > 0
+    assert out["cst_captions_per_sec"] > 0
+    # the headline must be the worse stage, and labeled with its path
+    assert out["value"] == min(out["xe_captions_per_sec"],
+                               out["cst_captions_per_sec"])
+    assert out["cst_path"] in ("device_fused", "host_pipeline",
+                               "host_pipeline_fallback")
+    assert out["cst_scorer"] in ("native", "python")
+    # host-path numbers are always reported alongside
+    assert out["cst_host_pipeline_captions_per_sec"] > 0
+    assert out["cst_serial_captions_per_sec"] > 0
+
+
+def test_stage_xe_isolates():
+    out = run_bench("--stage", "xe")
+    assert out["metric"] == "xe_captions_per_sec_per_chip"
+    assert out["value"] > 0
