@@ -17,7 +17,10 @@ pytestmark = pytest.mark.e2e
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TINY = ["--batch_size", "2", "--seq_per_img", "2", "--seq_len", "8",
         "--vocab", "60", "--hidden", "16", "--steps", "2",
-        "--platform", "cpu"]
+        # child_timeout below the subprocess timeout: if the bench wedges,
+        # its own-session measurement child dies before this test's 900s
+        # kill (which can only reach the direct bench.py driver process).
+        "--platform", "cpu", "--child_timeout", "600"]
 
 
 def run_bench(*extra):
