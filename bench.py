@@ -306,8 +306,10 @@ def parse_args():
     p.add_argument("--probe_timeout", type=float, default=120.0,
                    help="seconds before one backend-init probe is declared wedged")
     p.add_argument("--probe_retries", type=int, default=2)
-    p.add_argument("--child_timeout", type=float, default=3600.0,
-                   help="seconds for the measurement child process")
+    p.add_argument("--child_timeout", type=float, default=1800.0,
+                   help="seconds for ONE measurement child process (a "
+                        "wedge-mid-measurement worst case pays this twice: "
+                        "device attempt + CPU-fallback rerun)")
     return p.parse_args()
 
 
@@ -361,6 +363,9 @@ def _emit(result: dict, args) -> None:
                 cache = {"entries": {}}
             cache["entries"][metric] = {
                 "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                # steps rides along informationally (averaging length of
+                # the cached measurement) without joining the identity.
+                "steps": args.steps,
                 "config": config, "result": result,
             }
             with open(TPU_CACHE, "w") as f:
