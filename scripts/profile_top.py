@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Summarize a ``--profile_dir`` trace: top device ops by total time.
+
+The trainer's ``--profile_dir/--profile_start/--profile_steps`` flags
+capture a ``jax.profiler`` trace (training/trainer.py); TensorBoard can
+render it, but the fastest question — "what dominates the step?" — needs
+no UI.  This reads the xplane protobuf back through
+``jax.profiler.ProfileData`` and prints per-line (XLA Modules / XLA Ops /
+host threads) totals, the tool that found the decoder-cell remat win
+(PARITY.md: attention residuals at 2.3 GB/step).
+
+Usage:
+  python scripts/profile_top.py /path/to/profile_dir [--top 15]
+  python scripts/profile_top.py trace.xplane.pb --line "XLA Ops"
+"""
+import argparse
+import glob
+import os
+import sys
+from collections import defaultdict
+
+
+def find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                            recursive=True))
+    if not hits:
+        sys.exit(f"no *.xplane.pb under {path!r} — was the trace captured "
+                 "with --profile_dir (or jax.profiler.trace)?")
+    return hits[-1]  # newest capture
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("trace", help="profile dir or .xplane.pb file")
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument("--line", default=None,
+                   help="only lines whose name contains this substring "
+                        "(e.g. 'XLA Ops'); default: every line with events")
+    p.add_argument("--plane", default=None,
+                   help="only planes whose name contains this substring "
+                        "(e.g. 'TPU'); default: device planes, then host")
+    args = p.parse_args()
+
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(find_xplane(args.trace))
+    planes = list(pd.planes)
+    if args.plane:
+        planes = [pl for pl in planes if args.plane in pl.name]
+    else:
+        dev = [pl for pl in planes if "/device:" in pl.name]
+        planes = dev or planes
+
+    for plane in planes:
+        for line in plane.lines:
+            if args.line and args.line not in line.name:
+                continue
+            tot = defaultdict(float)
+            cnt = defaultdict(int)
+            t0, t1 = None, None
+            for ev in line.events:
+                tot[ev.name] += ev.duration_ns
+                cnt[ev.name] += 1
+                start = getattr(ev, "start_ns", None)
+                if start is not None:
+                    t0 = start if t0 is None else min(t0, start)
+                    t1 = (start + ev.duration_ns if t1 is None
+                          else max(t1, start + ev.duration_ns))
+            if not tot:
+                continue
+            # Span is WALL CLOCK (max end - min start), not the sum of
+            # durations: events on a line can nest (TraceAnnotations wrap
+            # children), so summing would double-count host lines.  The
+            # per-op totals below still include parents' time over their
+            # children on such lines.
+            span = (t1 - t0) if t0 is not None else sum(tot.values())
+            print(f"== {plane.name} :: {line.name} — "
+                  f"{len(tot)} distinct, {span / 1e6:.2f} ms span")
+            for name, ns in sorted(tot.items(), key=lambda kv: -kv[1])[
+                    :args.top]:
+                print(f"  {ns / 1e6:10.3f} ms  x{cnt[name]:<6d} "
+                      f"{name[:100]}")
+
+
+if __name__ == "__main__":
+    main()
