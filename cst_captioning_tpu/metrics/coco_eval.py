@@ -24,6 +24,20 @@ from .tokenizer import tokenize_corpus
 
 _warned_meteor = False
 
+# Metrics whose emitted key differs from the selection name the CLI keeps
+# for reference compatibility.  METEOR here is the pure-Python 2005
+# approximation (no WordNet/paraphrase data in this environment), so every
+# output channel — scores JSONs, metrics.jsonl, printed tables — carries it
+# as METEOR_approx: a bare "METEOR" column invites silent mis-comparison
+# against jar METEOR-1.5 literature numbers (VERDICT r3 #4).
+# ``--eval_metric METEOR`` still selects it (see score_key).
+APPROX_SCORE_KEYS = {"METEOR": "METEOR_approx"}
+
+
+def score_key(metric: str) -> str:
+    """Emitted-scores key for a CLI ``--eval_metric`` name."""
+    return APPROX_SCORE_KEYS.get(metric, metric)
+
 
 def load_cocofmt_refs(cocofmt_file: str) -> Dict[str, List[str]]:
     """Read a coco-format annotations JSON into {image_id: [caption, ...]}."""
@@ -64,20 +78,20 @@ def language_eval(
         bleus, _ = compute_bleu(gts, res, n=4)
         for i, b in enumerate(bleus, 1):
             out[f"Bleu_{i}"] = float(b)
-    if "METEOR" in scorers:
+    if "METEOR" in scorers or "METEOR_approx" in scorers:
         global _warned_meteor
         if not _warned_meteor:
             # An approximated METEOR column silently compared against
             # jar-METEOR literature numbers is worse than a missing one
             # (VERDICT r2) — say so once, loudly, at scoring time.
             logging.getLogger("cst_captioning_tpu.metrics").warning(
-                "METEOR here is the pure-Python 2005-algorithm "
+                "METEOR_approx is the pure-Python 2005-algorithm "
                 "approximation (exact+stem matching, no WordNet/paraphrase "
                 "modules) — NOT numerically comparable to meteor-1.5.jar "
                 "numbers from the literature; see metrics/meteor.py"
             )
             _warned_meteor = True
-        out["METEOR"] = compute_meteor(gts, res)[0]
+        out["METEOR_approx"] = compute_meteor(gts, res)[0]
     if "ROUGE_L" in scorers:
         out["ROUGE_L"] = compute_rouge(gts, res)[0]
     res_list = [{"image_id": k, "caption": v} for k, v in res.items()]

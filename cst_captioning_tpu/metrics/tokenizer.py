@@ -157,6 +157,15 @@ def tokenize_corpus(captions_for_key: Dict[str, Iterable[str]],
             if out[key][j] is None:
                 flat_keys.append((key, j))
                 flat.append(c)
-    for (key, j), tok in zip(flat_keys, native(flat)):
+    try:
+        toks = native(flat)
+    except Exception:
+        # A runtime fault of the C++ batch call (not just startup
+        # unavailability) must also fall back to the Python oracle, and
+        # pin the fallback so later calls don't re-fault (ADVICE r3).
+        global _native_batch
+        _native_batch = False
+        toks = [tokenize_to_str(c) for c in flat]
+    for (key, j), tok in zip(flat_keys, toks):
         out[key][j] = tok
     return out

@@ -328,10 +328,20 @@ def ptb_tokenize_batch(captions: Sequence[str]) -> List[str]:
             raise ValueError("native tokenizer is ASCII-only")
         encoded.append(c.encode("ascii"))
     lib = load_tokenizer_library()
+    total = sum(len(e) for e in encoded)
+    cap = max(2 * total + 64 * len(encoded), 256)
+    # The C ABI uses int32 offsets and an int output capacity: a >2 GiB
+    # blob would otherwise overflow to negative offsets silently (np.cumsum
+    # into int32 down-casts without a check).  Fail loudly instead —
+    # callers (tokenize_corpus) fall back to the Python path (ADVICE r3).
+    if cap > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"native tokenizer batch too large for int32 offsets "
+            f"({total} input bytes, {cap} output capacity); split the "
+            "batch or use the Python tokenizer")
     offs = np.zeros(len(encoded) + 1, dtype=np.int32)
     np.cumsum([len(e) for e in encoded], out=offs[1:])
     blob = b"".join(encoded)
-    cap = max(2 * len(blob) + 64 * len(encoded), 256)
     out = ctypes.create_string_buffer(cap)
     out_offs = np.zeros(len(encoded) + 1, dtype=np.int32)
     n = lib.ptb_tokenize_batch(

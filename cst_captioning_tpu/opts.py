@@ -50,6 +50,12 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
                         "transfers.  Needs the feature set to fit in HBM "
                         "(MSR-VTT ~0.8 GB in bf16); 0 = stream per batch "
                         "via the prefetch thread")
+    g.add_argument("--device_feats_max_gb", type=float, default=8.0,
+                   help="startup guard for --device_feats: fail loudly when "
+                        "the replicated feature table would exceed this many "
+                        "GB PER DEVICE (the table is full-size on every "
+                        "device regardless of mesh shape), instead of an "
+                        "opaque device OOM mid-epoch")
     g.add_argument("--preload_feats", type=int, default=0,
                    help="1 = read all feature h5s into host RAM at startup "
                         "(removes per-batch disk IO; needs dataset-sized RAM)")

@@ -52,17 +52,22 @@ def with_host_device_count(flags: str, n: int) -> str:
 
 
 def run_in_group(cmd: list, *, env: dict, cwd: str | None = None,
-                 timeout: float | None = None) -> int:
+                 timeout: float | None = None, stdout=None) -> int:
     """Run ``cmd`` in its own process GROUP with inherited stdio.
 
     On timeout, SIGKILL the whole group — a wedged PJRT tunnel plugin can
     spawn helper processes that outlive a direct-child kill — and return
     124 (the coreutils ``timeout`` convention).  Otherwise return the rc.
+
+    ``stdout`` may be a FILE object (not a pipe) to capture the child's
+    stdout; a file stays safe across the group kill because no reader can
+    block on it, unlike a pipe held open by orphaned tunnel helpers.
     """
     import signal
     import subprocess
 
-    proc = subprocess.Popen(cmd, env=env, cwd=cwd, start_new_session=True)
+    proc = subprocess.Popen(cmd, env=env, cwd=cwd, start_new_session=True,
+                            stdout=stdout)
     try:
         return proc.wait(timeout=timeout)
     except subprocess.TimeoutExpired:

@@ -66,9 +66,11 @@ def main():
 
         # Timing barriers are scalar VALUE fetches and the per-step time is
         # the SLOPE between a short and a long loop — both defenses against
-        # the remote-tunnel backend, whose block_until_ready was observed to
-        # return early (bench.py barrier note) and whose fixed round-trip
-        # latency would otherwise pollute a single-loop measurement.
+        # the remote-tunnel backend: the value fetch is unconditionally
+        # trustworthy as a barrier (bench.py barrier note; one unconfirmed
+        # block_until_ready anomaly motivated the swap), and the slope
+        # cancels the tunnel's fixed round-trip latency which would
+        # otherwise pollute a single-loop measurement.
         def timed(fn, fn_args, state, n):
             t0 = time.perf_counter()
             for i in range(n):

@@ -150,3 +150,45 @@ def test_batch_matches_scalar():
     assert ptb_tokenize_batch([]) == []
     with pytest.raises(ValueError):
         ptb_tokenize_batch(["ok", "café"])
+
+
+def test_corpus_runtime_native_fault_falls_back(monkeypatch):
+    """A RUNTIME fault of the batched native call (not just startup
+    unavailability) must degrade to the Python oracle and pin the native
+    path off for the rest of the process (ADVICE r3)."""
+    from cst_captioning_tpu.metrics import tokenizer as tk
+
+    def boom(flat):
+        raise RuntimeError("simulated C++ fault")
+
+    monkeypatch.setattr(tk, "_native_batch", boom)
+    caps = {"v": ["a man runs.", "don't stop"]}
+    out = tk.tokenize_corpus(caps)
+    assert out["v"] == [tokenize_to_str(c) for c in caps["v"]]
+    # pinned off: later corpus calls go straight to Python, no re-fault
+    assert tk._native_batch is False
+    assert tk.tokenize_corpus(caps)["v"] == out["v"]
+    tk._native_batch = None  # un-pin for other tests in this process
+
+
+def test_batch_int32_capacity_guard(monkeypatch):
+    """A blob whose output capacity would overflow the C ABI's int32
+    offsets must fail loudly (callers fall back to Python), not wrap to
+    negative offsets (ADVICE r3)."""
+    import cst_captioning_tpu.native as nat
+
+    class FakeStr(str):
+        # pretend to be gigantic without allocating 2 GiB in CI
+        def isascii(self):
+            return True
+
+        def encode(self, *a):
+            return FakeBytes()
+
+    class FakeBytes(bytes):
+        def __len__(self):
+            return 2**31 - 100
+
+    monkeypatch.setattr(nat, "load_tokenizer_library", lambda: object())
+    with pytest.raises(ValueError, match="int32"):
+        nat.ptb_tokenize_batch([FakeStr("x")])
