@@ -319,6 +319,17 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_TPU_CACHE.json")
 
 
+def read_cache_entry(metric: str):
+    """Last cached device measurement for ``metric``, or None (missing
+    file, bad JSON, unknown metric) — shared by _emit's CPU-fallback
+    attach and last_resort_emit so the cache schema has ONE reader."""
+    try:
+        with open(TPU_CACHE) as f:
+            return json.load(f).get("entries", {}).get(metric)
+    except (OSError, ValueError):
+        return None
+
+
 def resolved_config(args) -> dict:
     """The perf-affecting configuration identity of a run, with the
     follow-the-trainer-default flags (None) normalized to their resolved
@@ -381,14 +392,10 @@ def _emit(result: dict, args) -> None:
                 json.dump(cache, f, indent=2)
         except (OSError, ValueError):
             pass
-    elif os.path.exists(TPU_CACHE):
-        try:
-            with open(TPU_CACHE) as f:
-                entry = json.load(f).get("entries", {}).get(metric)
-            if entry is not None and entry.get("config") == config:
-                result = {**result, "last_tpu_result": entry}
-        except (OSError, ValueError):
-            pass
+    else:
+        entry = read_cache_entry(metric)
+        if entry is not None and entry.get("config") == config:
+            result = {**result, "last_tpu_result": entry}
     print(json.dumps(result))
 
 
@@ -578,20 +585,16 @@ def last_resort_emit(args, child_rc: int, reason: str) -> None:
         "child_rc": child_rc,
         "error": reason,
     }
-    try:
-        with open(TPU_CACHE) as f:
-            entry = json.load(f).get("entries", {}).get(metric)
-        if entry is not None:
-            result["last_tpu_result"] = entry
-            # Unlike _emit's CPU-fallback attach, the entry rides along
-            # even when this run's shapes differ (there is no fresher
-            # number to prefer) — but labeled, so a consumer can't read
-            # a full-shape cached number as comparable to a tiny-shape
-            # wedged run without noticing.
-            result["last_tpu_config_matches"] = (
-                entry.get("config") == resolved_config(args))
-    except (OSError, ValueError):
-        pass
+    entry = read_cache_entry(metric)
+    if entry is not None:
+        result["last_tpu_result"] = entry
+        # Unlike _emit's CPU-fallback attach, the entry rides along even
+        # when this run's shapes differ (there is no fresher number to
+        # prefer) — but labeled, so a consumer can't read a full-shape
+        # cached number as comparable to a tiny-shape wedged run without
+        # noticing.
+        result["last_tpu_config_matches"] = (
+            entry.get("config") == resolved_config(args))
     print(json.dumps(result))
 
 

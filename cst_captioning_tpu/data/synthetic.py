@@ -38,10 +38,70 @@ class SyntheticSpec:
     feat_dims: Tuple[int, ...] = (32, 16)     # e.g. tiny "resnet" + "c3d"
     feat_times: Tuple[int, ...] = (4, 1)      # temporal frames per modality
     seed: int = 0
+    # > 0 switches the caption grammar to a parameterized large-vocabulary
+    # pool of about this many distinct words (MSR-VTT-scale runs use 8000),
+    # with per-video (adj, subject, verb, prep, object) concepts and
+    # paraphrase variation — so vocab-size-realistic statistics while
+    # captions stay consensus-structured (CIDEr has signal).  0 keeps the
+    # original 15-word grammar (tests/fixtures).
+    rich_vocab: int = 0
 
 
-def _make_captions(rng: np.random.Generator, spec: SyntheticSpec) -> List[List[str]]:
-    """Per video: one (subject, verb, object) concept + paraphrase captions."""
+def _rich_pools(n_words: int):
+    """Deterministic word pools summing to roughly ``n_words``."""
+    n_nouns = max(n_words * 45 // 100, 4)
+    n_verbs = max(n_words * 30 // 100, 2)
+    n_adjs = max(n_words - n_nouns - n_verbs - 8, 2)
+    nouns = [f"noun{i}" for i in range(n_nouns)]
+    verbs = [f"verb{i}ing" for i in range(n_verbs)]
+    adjs = [f"adj{i}" for i in range(n_adjs)]
+    preps = ["in", "on", "with", "near", "under", "behind"]
+    return nouns, verbs, adjs, preps
+
+
+def _make_captions(rng: np.random.Generator, spec: SyntheticSpec,
+                   vocab: Vocab | None = None) -> List[List[str]]:
+    """Per video: one concept + paraphrase captions.
+
+    Tiny grammar (default): (subject, verb, object) from 15 fixed words.
+    Rich grammar (``rich_vocab > 0``): (adj, subj, verb, prep, obj) drawn
+    from ~rich_vocab pooled words; paraphrases share the concept's content
+    n-grams (high intra-video consensus, like the 20 MSR-VTT captions) but
+    vary articles/adjunct inclusion so consensus training has headroom.
+
+    ``vocab`` (val/test generation): restrict rich-grammar draws to words
+    the TRAIN split realized — otherwise most val concepts would be words
+    the model has never seen (mapped to <unk> at encode time), and val
+    metrics would measure vocabulary luck instead of learning.  Real
+    datasets' splits share a vocabulary; the synthetic one must too.
+    """
+    if spec.rich_vocab:
+        nouns, verbs, adjs, preps = _rich_pools(spec.rich_vocab)
+        if vocab is not None:
+            known = set(vocab.word_to_ix)
+            nouns_k = [w for w in nouns if w in known]
+            verbs_k = [w for w in verbs if w in known]
+            adjs_k = [w for w in adjs if w in known]
+            if len(nouns_k) >= 2 and verbs_k and adjs_k:
+                nouns, verbs, adjs = nouns_k, verbs_k, adjs_k
+        all_caps = []
+        for _ in range(spec.num_videos):
+            s, o = (nouns[rng.integers(len(nouns))],
+                    nouns[rng.integers(len(nouns))])
+            v = verbs[rng.integers(len(verbs))]
+            a = adjs[rng.integers(len(adjs))]
+            p = preps[rng.integers(len(preps))]
+            forms = [
+                f"a {a} {s} is {v} {p} the {o}",
+                f"the {s} is {v} {p} a {o}",
+                f"a {s} {v} {p} the {o}",
+                f"the {a} {s} is {v}",
+                f"a {s} is {v} {p} the {o}",
+            ]
+            caps = [forms[j % len(forms)]
+                    for j in range(spec.captions_per_video)]
+            all_caps.append(caps)
+        return all_caps
     all_caps = []
     for _ in range(spec.num_videos):
         s = _SUBJECTS[rng.integers(len(_SUBJECTS))]
@@ -64,7 +124,7 @@ def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpe
     # crc32, not hash(): str hashing is salted per process and would make
     # regenerated splits differ between interpreter runs.
     rng = np.random.default_rng(spec.seed + zlib.crc32(split.encode()))
-    captions = _make_captions(rng, spec)
+    captions = _make_captions(rng, spec, vocab=vocab)
     video_ids = [f"{split}_video{i}" for i in range(spec.num_videos)]
 
     paths = build_split(
@@ -75,14 +135,31 @@ def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpe
 
     # Features: deterministic per-video signal derived from the first
     # caption's token ids, so features genuinely predict captions.
+    #
+    # Tiny grammar: one-hot-ish bucket bumps (tok % dim) — dim >= vocab in
+    # tests, so buckets are collision-free and trivially separable.
+    # Rich grammar: vocab >> dim makes buckets collide 4+ ways; use a
+    # fixed random SIGNATURE per token instead (near-orthogonal dense
+    # vectors) so the word -> feature map stays linearly recoverable at
+    # MSR-VTT vocab/dim ratios — the learnability the real CNN features
+    # have, which bucket collisions destroy.
     feat_paths = []
+    sig_rng = np.random.default_rng(spec.seed + 7919)
+    n_words = len(vocab) + 1
     for m, (dim, t_len) in enumerate(zip(spec.feat_dims, spec.feat_times)):
+        signatures = None
+        if spec.rich_vocab:
+            signatures = sig_rng.standard_normal(
+                (n_words, dim)).astype(np.float32) / np.sqrt(dim)
         feats = np.zeros((spec.num_videos, t_len, dim), dtype=np.float32)
         for i, caps in enumerate(captions):
             concept = rng.standard_normal(dim) * 0.1
             ids = vocab.encode(tokenize(caps[0]), spec.max_len)
             for tok in ids[ids > 0]:
-                concept[int(tok) % dim] += 1.0
+                if signatures is not None:
+                    concept += signatures[int(tok) % n_words] * 3.0
+                else:
+                    concept[int(tok) % dim] += 1.0
             feats[i] = concept[None, :] + 0.01 * rng.standard_normal((t_len, dim))
         p = f"{root}/{split}_feat{m}.h5"
         with h5py.File(p, "w") as f:

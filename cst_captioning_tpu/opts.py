@@ -50,6 +50,11 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
                         "transfers.  Needs the feature set to fit in HBM "
                         "(MSR-VTT ~0.8 GB in bf16); 0 = stream per batch "
                         "via the prefetch thread")
+    g.add_argument("--device_cider_chunk_mb", type=float, default=256.0,
+                   help="HBM budget for the on-device CIDEr-D hyp-ref match "
+                        "transient; when batch x refs x lengths would exceed "
+                        "it, the reward contraction is chunked over the "
+                        "reference axis (bit-identical scores, bounded peak)")
     g.add_argument("--device_feats_max_gb", type=float, default=8.0,
                    help="startup guard for --device_feats: fail loudly when "
                         "the replicated feature table would exceed this many "

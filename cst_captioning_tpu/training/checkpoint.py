@@ -11,11 +11,14 @@ model hyperparams come from the checkpoint, not the CLI.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
 
 
 class CheckpointManager:
@@ -96,6 +99,18 @@ class CheckpointManager:
         ):
             self.infos["best_score"] = float(score)
             self.infos["best_step"] = int(step)
+        if score is not None:
+            # Per-step score record, pruned to the steps orbax actually
+            # retained: best_fn trimming keeps the top-k by SCORE with ties
+            # broken arbitrarily, so the strict-> best_step above can be
+            # trimmed when scores tie (plateau) — restore(best=True) then
+            # falls back to the best RETAINED step via this record.
+            kept = set(self._mgr.all_steps())
+            scores = {s: v for s, v in
+                      self.infos.get("step_scores", {}).items()
+                      if int(s) in kept}
+            scores[str(int(step))] = float(score)
+            self.infos["step_scores"] = scores
         if extra:
             self.infos.update(extra)
         self.infos["last_step"] = int(step)
@@ -134,6 +149,13 @@ class CheckpointManager:
         s = self.infos.get("best_step")
         return int(s) if s is not None else None
 
+    def _available_steps(self) -> set:
+        steps = set(self._mgr.all_steps())
+        rec_dir = os.path.join(self.directory, "recovery")
+        if self._recovery is not None or os.path.isdir(rec_dir):
+            steps |= set(self._recovery_mgr().all_steps())
+        return steps
+
     def _resolve_step(self, step: Optional[int], best: bool) -> int:
         if step is None:
             # A stage trained without a val split never records scores, so
@@ -141,6 +163,26 @@ class CheckpointManager:
             # rather than failing stage chaining / eval.
             step = (self.best_step if best and self.best_step is not None
                     else self.latest_step)
+            avail = (self._available_steps()
+                     if best and step is not None else ())
+            if best and step is not None and step not in avail:
+                # The recorded best step's DATA was trimmed: orbax keeps
+                # the top-k by score with ties broken arbitrarily, while
+                # best_step records the FIRST of tied scores (strict >).
+                # Equal score == equal quality — restore the best step
+                # that was retained (smallest step among the top scores).
+                scores = {int(s): v for s, v in
+                          self.infos.get("step_scores", {}).items()
+                          if int(s) in avail}
+                if scores:
+                    trimmed = step
+                    step = min(scores, key=lambda s: (-scores[s], s))
+                    log.warning(
+                        "best step %d was trimmed by checkpoint retention; "
+                        "restoring best retained step %d (score %s)",
+                        trimmed, step, scores[step])
+                else:
+                    step = self.latest_step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         return step

@@ -127,6 +127,7 @@ def make_fused_cst_step(
     baseline: str = "greedy",
     temperature: float = 1.0,
     scb_gt_baseline=None,      # (V,) f32 per-video baseline for scb-gt
+    ref_chunk: int | None = None,
 ) -> Callable:
     """(state, feats, video_ix, rng) -> (state, metrics): the ENTIRE CST
     iteration as ONE device program — rollout, on-device CIDEr-D rewards
@@ -138,6 +139,10 @@ def make_fused_cst_step(
     (SURVEY.md §3.2), enabled with --device_rewards.  ``video_ix`` is the
     batch's dataset video indices (Batch.video_ix), which index the
     reference tables directly.
+
+    ``ref_chunk`` bounds the reward's transient HBM (see
+    ops.jax_ciderd.auto_ref_chunk); scores agree to float32 ULP level
+    either way (test-pinned).
     """
     from ..ops.jax_ciderd import ciderd_scores
 
@@ -162,11 +167,12 @@ def make_fused_cst_step(
             greedy = None
         sampled = jax.lax.stop_gradient(sampled)
         hyp_vix = jnp.repeat(video_ix, seq_per_img)
-        r_sample = ciderd_scores(sampled, hyp_vix, corpus, tables)
+        r_sample = ciderd_scores(sampled, hyp_vix, corpus, tables,
+                                 ref_chunk=ref_chunk)
         if baseline == "greedy":
             r_base = jnp.repeat(
                 ciderd_scores(jax.lax.stop_gradient(greedy), video_ix,
-                              corpus, tables),
+                              corpus, tables, ref_chunk=ref_chunk),
                 seq_per_img,
             )
         elif baseline == "scb-sample":

@@ -23,10 +23,16 @@ TINY = ["--batch_size", "2", "--seq_per_img", "2", "--seq_len", "8",
         "--platform", "cpu", "--child_timeout", "600"]
 
 
+from conftest import CACHE_DIR
+
+
 def run_bench(*extra):
     env = dict(os.environ)
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
+    # share the suite's persistent compile cache (conftest.py): repeat
+    # bench-child compiles of identical tiny-shape HLO become loads
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     # Output to temp FILES, not pipes: bench's measurement child runs in
     # its own session and would keep inherited pipes open past a timeout
     # kill, turning the post-timeout drain into a second unbounded hang
@@ -83,6 +89,7 @@ def _run_wedged(platform):
     env = dict(os.environ)
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     import tempfile
 
     args = TINY[:-1] + ["3"]

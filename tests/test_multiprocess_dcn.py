@@ -78,7 +78,11 @@ _, m_ref = jax.jit(step)(state, [jnp.asarray(f) for f in feats_np],
                          jnp.asarray(labels_np), jnp.asarray(weights_np), key)
 loss_ref = float(m_ref["loss"])
 
-dstate = jax.device_put(state, replicated_sharding(mesh))
+# host-numpy detour: device_put of an on-device state can ALIAS its
+# buffers into the global array, so donating one sharded copy would
+# delete the other's (and state's) underlying storage
+host_state = jax.tree_util.tree_map(np.asarray, state)
+dstate = jax.device_put(host_state, replicated_sharding(mesh))
 dfeats = shard_batch_arrays(mesh, [jnp.asarray(f) for f in feats_np])
 dlabels = shard_batch_arrays(mesh, jnp.asarray(labels_np))
 dweights = shard_batch_arrays(mesh, jnp.asarray(weights_np))
@@ -87,18 +91,58 @@ _, m = data_parallel_jit(step, mesh, batch_argnums=(1, 2, 3),
     dstate, dfeats, dlabels, dweights, key)
 loss = float(m["loss"])
 
+# -- fused device-reward CST step across the process boundary ------------
+# (--device_rewards 1 — the path pods actually train; VERDICT r3 #6)
+from cst_captioning_tpu.training.device_rewards import build_device_tables
+from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+NV = 5
+vocab_words = {i: f"w{i}" for i in range(1, V)}
+w2i = {w: i for i, w in vocab_words.items()}
+refs = {f"v{i}": [" ".join(f"w{1 + ((i + j + k) %% (V - 1))}"
+                           for k in range(5)) for j in range(3)]
+        for i in range(NV)}
+corpus, tables, video_row = build_device_tables(refs, w2i)
+fused = make_fused_cst_step(model, L, S, corpus, tables)
+vix_np = np.asarray([video_row[f"v{i}"] for i in range(B)], np.int32)
+dstate2 = jax.device_put(host_state, replicated_sharding(mesh))
+dfeats2 = shard_batch_arrays(mesh, [jnp.asarray(f) for f in feats_np])
+dvix = shard_batch_arrays(mesh, jnp.asarray(vix_np))
+fstate, fm = data_parallel_jit(fused, mesh, batch_argnums=(1, 2),
+                               donate_argnums=(0,))(
+    dstate2, dfeats2, dvix, key)
+cst_loss = float(fm["loss"])
+cst_reward = float(fm["reward"])
+# post-step params must be IDENTICAL on both hosts (grad psum crossed the
+# process boundary; any divergence here means pods drift silently)
+params_digest = hashlib.sha256(b"".join(
+    np.asarray(l).tobytes()
+    for l in jax.tree_util.tree_leaves(fstate.params))).hexdigest()
+
 # -- gather_strided_predictions with the REAL process_allgather ----------
 from cst_captioning_tpu.training.evaluation import gather_strided_predictions
-vids = [f"v{i}" for i in range(5)]       # P0 strides 3 rows, P1 strides 2
-mine = np.asarray([[10 * pid + i, 7, 0] for i in range(len(vids))
-                   if i %% 2 == pid], dtype=np.int32)
+vids = [f"v{i}" for i in range(NV)]      # P0 strides 3 rows, P1 strides 2
+mine = np.asarray([[1 + (3 * i) %% (V - 1), 1 + (5 * i) %% (V - 1), 0]
+                   for i in range(NV) if i %% 2 == pid], dtype=np.int32)
 ids, rows = gather_strided_predictions(mine, vids, pid, 2)
 digest = hashlib.sha256(
     (",".join(ids) + "|" + np.concatenate(rows).tobytes().hex())
     .encode()).hexdigest()
 
+# -- validate()-equivalence: every host scores the identical full split --
+# (identical metric value -> identical best-step/early-stop bookkeeping)
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.metrics.coco_eval import language_eval
+vb = Vocab(vocab_words)
+preds = [{"image_id": vid, "caption": vb.decode(r)}
+         for vid, r in zip(ids, rows)]
+val_metric = language_eval(preds, refs, scorers=("CIDEr",))["CIDEr"]
+
 print(json.dumps({"pid": pid, "red": red, "loss": loss,
-                  "loss_ref": loss_ref, "ids": ids, "digest": digest}),
+                  "loss_ref": loss_ref, "ids": ids, "digest": digest,
+                  "cst_loss": cst_loss, "cst_reward": cst_reward,
+                  "params_digest": params_digest,
+                  "val_metric": val_metric}),
       flush=True)
 """
 
@@ -117,6 +161,9 @@ def test_two_process_backend(tmp_path):
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    from conftest import CACHE_DIR
+
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(port)],
@@ -150,8 +197,18 @@ def test_two_process_backend(tmp_path):
     assert a["loss"] == pytest.approx(b["loss"], rel=1e-6)
     for r in (a, b):
         assert r["loss"] == pytest.approx(r["loss_ref"], rel=1e-5), r
+    # The fused device-reward CST step (the shipped --device_rewards path)
+    # agrees across the process boundary: same loss/reward on both hosts
+    # and BIT-identical post-step params (grad psum crossed DCN).
+    assert a["cst_loss"] == pytest.approx(b["cst_loss"], rel=1e-6)
+    assert a["cst_reward"] == pytest.approx(b["cst_reward"], rel=1e-6)
+    assert a["params_digest"] == b["params_digest"]
     # Real process_allgather reassembled the FULL split (every video,
     # shard-concatenation order) identically on both hosts.
     assert sorted(a["ids"]) == [f"v{i}" for i in range(5)]
     assert a["ids"] == b["ids"]
     assert a["digest"] == b["digest"]
+    # ...and the selection metric computed from it is identical, so
+    # best-step / early-stop bookkeeping cannot diverge across hosts.
+    assert a["val_metric"] == b["val_metric"]
+    assert a["val_metric"] > 0.0

@@ -418,6 +418,21 @@ def test_device_rewards_stage(data, tmp_path_factory):
     assert res_gt["best_score"] is not None
 
 
+def test_device_rewards_chunked_envelope(data, tmp_path_factory):
+    """A micro --device_cider_chunk_mb forces the reward contraction into
+    ref-axis chunks (the HBM-envelope bound); the fused stage must train
+    through the full CLI surface exactly as the one-shot path does."""
+    out = str(tmp_path_factory.mktemp("devrl_chunk"))
+    res = run_stage(
+        data, os.path.join(out, "chunked"),
+        **{"--use_rl": ["1"], "--device_rewards": ["1"],
+           "--device_cider_chunk_mb": ["0.0001"],
+           "--max_epochs": ["1"]},
+    )
+    assert res["best_score"] is not None
+    assert res["last_step"] == 2
+
+
 def test_scb_sample_stage(data, tmp_path_factory):
     """Host-path (--device_rewards 0) SCB-sample e2e; the fused-path SCB
     variants live in test_device_rewards_stage."""

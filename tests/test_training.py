@@ -311,6 +311,33 @@ class TestCheckpoint:
             jax.tree_util.tree_structure(state.params)
         mgr.close()
 
+    def test_tied_score_plateau_best_restorable(self, setup, tmp_path):
+        """Round-4 field bug: on a val-score PLATEAU (ties), orbax's
+        best_fn retention keeps the top-k by score with ties broken
+        arbitrarily, while best_step records the FIRST tied step (strict
+        >).  After enough tied epochs the recorded best step's data is
+        trimmed, and restore(best=True) used to crash with
+        FileNotFoundError mid stage-chain.  It must instead restore the
+        best RETAINED step (same score == same quality)."""
+        _, state, _, _ = setup
+        d = str(tmp_path / "plateau")
+        mgr = CheckpointManager(d, max_to_keep=2)
+        for s, sc in [(1, 0.5), (2, 0.5), (3, 0.5), (4, 0.5), (5, 0.2)]:
+            mgr.save(s, state.replace(step=jnp.asarray(s)), score=sc)
+        assert mgr.best_step == 1  # first of the tied scores
+        restored = mgr.restore(state, best=True)  # must NOT raise
+        kept = set(mgr._mgr.all_steps())
+        assert int(restored.step) in kept
+        # among retained steps, the one restored has the top score
+        assert mgr.infos["step_scores"][str(int(restored.step))] == 0.5
+        mgr.close()
+        # fresh manager over the same dir (the stage-chain warm-start path)
+        mgr2 = CheckpointManager(d)
+        p = mgr2.restore_params(state.params, best=True)  # must NOT raise
+        assert jax.tree_util.tree_structure(p) == \
+            jax.tree_util.tree_structure(state.params)
+        mgr2.close()
+
     def test_recovery_saves_trim_and_resume(self, setup, tmp_path):
         _, state, _, _ = setup
         d = str(tmp_path / "rec")

@@ -24,7 +24,9 @@ from cst_captioning_tpu.training.trainer import Trainer
 from cst_captioning_tpu.utils.platform import enable_compile_cache
 
 
-def main(argv=None) -> int:
+def main(argv=None, return_result: bool = False):
+    """CLI entry; ``return_result=True`` returns the summary dict instead
+    of the exit code (for driver scripts like scripts/scale_chain.py)."""
     opt = parse_opts(argv)
     logging.basicConfig(
         level=getattr(logging, opt.loglevel.upper(), logging.INFO),
@@ -46,7 +48,7 @@ def main(argv=None) -> int:
         "checkpoint_path": opt.checkpoint_path,
     }
     print(json.dumps(summary))
-    return 0
+    return summary if return_result else 0
 
 
 if __name__ == "__main__":
