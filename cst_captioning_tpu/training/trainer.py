@@ -665,6 +665,20 @@ class Trainer:
         # that crashes each epoch must early-stop at the same epoch as the
         # uninterrupted run (VERDICT r3 weak #4).
         patience = int(self.ckpt.infos.get("patience") or 0)
+        if opt.max_patience and patience >= opt.max_patience:
+            # The stage ALREADY early-stopped in a previous run; re-running
+            # it (e.g. the scale-chain recovery flow re-invoking every
+            # stage) must be a no-op, not train bonus epochs whose noisy
+            # val could resurrect a stopped run (round-4 review).
+            log.info("early stop already reached (%d epochs without %s "
+                     "improvement); nothing to train", patience,
+                     opt.eval_metric)
+            return {
+                "best_score": None if best == float("-inf") else best,
+                "best_step": self.ckpt.best_step,
+                "last_step": int(self.state.step),
+                "history": self.history,
+            }
         self._log_t0 = time.time()
         self._captions_done = 0
 

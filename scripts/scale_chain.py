@@ -77,12 +77,31 @@ def main() -> int:
     p.add_argument("--num_videos", type=int, default=640)
     p.add_argument("--num_val", type=int, default=128)
     p.add_argument("--batch_size", type=int, default=32)
-    p.add_argument("--xe_epochs", type=int, default=20)
-    p.add_argument("--wxe_epochs", type=int, default=6)
-    p.add_argument("--cst_epochs", type=int, default=15)
+    # XE must run to CONVERGENCE before RL: the round-4 CPU probes showed
+    # REINFORCE from a half-trained policy degrades val CIDEr (sampled
+    # rewards far below baseline, noisy negative advantages), while the
+    # same CST stage from a converged XE is stable-to-improving.  Epoch
+    # caps are ceilings; early stop (--max_patience below) ends stages.
+    p.add_argument("--xe_epochs", type=int, default=80)
+    p.add_argument("--wxe_epochs", type=int, default=20)
+    p.add_argument("--cst_epochs", type=int, default=25)
+    p.add_argument("--patience", type=int, default=8,
+                   help="early-stop patience for XE/WXE (0 = off); CST "
+                        "stages always run their full epoch budget so the "
+                        "learning curves are complete")
+    p.add_argument("--lr_decay_every", type=int, default=15,
+                   help="staircase decay period in epochs for XE/WXE "
+                        "(the 640-video synthetic has ~1/10 the steps of "
+                        "real MSR-VTT epochs, so decay slower than the "
+                        "reference's every-3)")
     p.add_argument("--stages", default="xe,wxe,cst",
-                   help="comma list from xe,wxe,cst,cst_scb,eval")
-    p.add_argument("--cst_lr", default="5e-5")
+                   help="comma list from xe,wxe,cst,cst_scb,"
+                        "cst_scb_sample,eval")
+    p.add_argument("--cst_temperature", default="1.0",
+                   help="multinomial sampling temperature for CST stages")
+    p.add_argument("--cst_lr", default="2e-5",
+                   help="probe-validated: 5e-5 destabilized REINFORCE "
+                        "from a converged warm start; 2e-5 was stable")
     p.add_argument("--device_rewards", default="1")
     p.add_argument("--rnn_size", type=int, default=512)
     p.add_argument("--rich_vocab", type=int, default=8000)
@@ -116,7 +135,12 @@ def main() -> int:
         "--att_size", str(args.rnn_size), "--max_length", "30",
         "--use_bfloat16", "1", "--device_feats", "1",
         "--save_every_steps", "100",  # tunnel-wedge recovery granularity
-        "--log_every", "10", "--fast_val", "1", "--max_patience", "0",
+        "--log_every", "10", "--fast_val", "1",
+    ]
+    xe_sched = [
+        "--max_patience", str(args.patience),
+        "--learning_rate_decay_every", str(args.lr_decay_every),
+        "--learning_rate_decay_rate", "0.5",
     ]
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
 
@@ -128,7 +152,7 @@ def main() -> int:
     if "xe" in stages:
         print("=== stage: XE pretrain ===", flush=True)
         report("xe", train_cli.main([
-            *common, "--checkpoint_path", f"{ckpt}/xe",
+            *common, *xe_sched, "--checkpoint_path", f"{ckpt}/xe",
             "--max_epochs", str(args.xe_epochs),
             "--learning_rate", args.xe_lr,
         ], return_result=True))
@@ -136,7 +160,7 @@ def main() -> int:
     if "wxe" in stages:
         print("=== stage: WXE warm-start ===", flush=True)
         report("wxe", train_cli.main([
-            *common, "--checkpoint_path", f"{ckpt}/wxe",
+            *common, *xe_sched, "--checkpoint_path", f"{ckpt}/wxe",
             "--start_from", f"{ckpt}/xe",
             "--use_consensus_weights", "1",
             "--train_bcmrscores_pkl", train["consensus_pkl"],
@@ -144,37 +168,46 @@ def main() -> int:
             "--learning_rate", "1e-4",
         ], return_result=True))
 
+    cst_common = [
+        "--start_from", f"{ckpt}/wxe",
+        "--use_rl", "1", "--max_patience", "0",  # full curves, no early stop
+        "--device_rewards", args.device_rewards,
+        "--temperature", args.cst_temperature,
+        "--train_cached_tokens", train["cached_tokens"],
+        "--max_epochs", str(args.cst_epochs),
+        "--learning_rate", args.cst_lr,
+    ]
+
     if "cst" in stages:
         print("=== stage: CST (greedy baseline, fused rewards) ===",
               flush=True)
         report("cst", train_cli.main([
-            *common, "--checkpoint_path", f"{ckpt}/cst",
-            "--start_from", f"{ckpt}/wxe",
-            "--use_rl", "1", "--rl_baseline", "greedy",
-            "--device_rewards", args.device_rewards,
-            "--train_cached_tokens", train["cached_tokens"],
-            "--max_epochs", str(args.cst_epochs),
-            "--learning_rate", args.cst_lr,
+            *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst",
+            "--rl_baseline", "greedy",
+        ], return_result=True))
+
+    if "cst_scb_sample" in stages:
+        print("=== stage: CST (SCB-sample leave-one-out baseline) ===",
+              flush=True)
+        report("cst_scb_sample", train_cli.main([
+            *common, *cst_common,
+            "--checkpoint_path", f"{ckpt}/cst_scb_sample",
+            "--rl_baseline", "scb-sample",
         ], return_result=True))
 
     if "cst_scb" in stages:
         print("=== stage: CST (SCB-gt baseline, fused rewards) ===",
               flush=True)
         report("cst_scb", train_cli.main([
-            *common, "--checkpoint_path", f"{ckpt}/cst_scb",
-            "--start_from", f"{ckpt}/wxe",
-            "--use_rl", "1", "--rl_baseline", "scb-gt",
-            "--device_rewards", args.device_rewards,
+            *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst_scb",
+            "--rl_baseline", "scb-gt",
             "--train_bcmrscores_pkl", train["consensus_pkl"],
-            "--train_cached_tokens", train["cached_tokens"],
-            "--max_epochs", str(args.cst_epochs),
-            "--learning_rate", args.cst_lr,
         ], return_result=True))
 
     if "eval" in stages:
         import eval as eval_cli
 
-        for stage in ("wxe", "cst", "cst_scb"):
+        for stage in ("wxe", "cst", "cst_scb", "cst_scb_sample"):
             d = f"{ckpt}/{stage}"
             if not os.path.exists(os.path.join(d, "infos.json")):
                 continue

@@ -223,6 +223,11 @@ def test_early_stop_patience_survives_resume(data, tmp_path_factory):
     # at the exact step the uninterrupted twin stopped
     res = run_stage(data, ckpt, **{**common, "--max_epochs": ["6"]})
     assert res["last_step"] == solid["last_step"] == 6
+    # re-running an already-early-stopped stage must be a NO-OP: zero
+    # extra epochs, not one noisy epoch that could resurrect the run
+    rerun = run_stage(data, ckpt, **{**common, "--max_epochs": ["6"]})
+    assert rerun["last_step"] == 6, "stopped stage trained extra epochs"
+    assert rerun["best_score"] == res["best_score"]
 
 
 def test_long_feature_stream_transformer(tmp_path_factory):
