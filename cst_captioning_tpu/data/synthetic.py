@@ -76,30 +76,55 @@ def _make_captions(rng: np.random.Generator, spec: SyntheticSpec,
     datasets' splits share a vocabulary; the synthetic one must too.
     """
     if spec.rich_vocab:
+        if spec.captions_per_video < 5:
+            # the 60/20/20 form mix needs >= 5 captions; fewer would emit
+            # only canonical forms (no adjectives realized, no consensus
+            # gap) and silently defeat both properties the grammar exists
+            # to provide
+            raise ValueError(
+                "rich_vocab grammar needs captions_per_video >= 5, got "
+                f"{spec.captions_per_video}")
         nouns, verbs, adjs, preps = _rich_pools(spec.rich_vocab)
         if vocab is not None:
+            # Restrict each pool INDEPENDENTLY to train-realized words
+            # (per-pool fallback to the full pool only if nothing of that
+            # class was realized): an all-or-nothing filter would
+            # reintroduce the val-unseen-word bug whenever one class is
+            # missing.
             known = set(vocab.word_to_ix)
-            nouns_k = [w for w in nouns if w in known]
-            verbs_k = [w for w in verbs if w in known]
-            adjs_k = [w for w in adjs if w in known]
-            if len(nouns_k) >= 2 and verbs_k and adjs_k:
-                nouns, verbs, adjs = nouns_k, verbs_k, adjs_k
+            def _keep(pool, min_n=1):
+                kept = [w for w in pool if w in known]
+                return kept if len(kept) >= min_n else pool
+            nouns = _keep(nouns, min_n=2)
+            verbs = _keep(verbs)
+            adjs = _keep(adjs)
+            preps = _keep(preps)
+        # MSR-VTT-like consensus structure: a DOMINANT caption form most
+        # annotators use, plus minority paraphrases carrying per-caption
+        # noise words.  This is what gives consensus training headroom
+        # over maximum likelihood: XE spreads probability over every
+        # observed form (noise included), while the CIDEr-consensus
+        # optimum is the majority form — CST can beat XE only if the two
+        # targets differ (arXiv:1712.09532's premise).  A grammar whose 20
+        # captions are near-identical leaves no such gap (round-4 probes:
+        # CST could only hold the warm start on the v1 grammar).
         all_caps = []
         for _ in range(spec.num_videos):
             s, o = (nouns[rng.integers(len(nouns))],
                     nouns[rng.integers(len(nouns))])
             v = verbs[rng.integers(len(verbs))]
-            a = adjs[rng.integers(len(adjs))]
             p = preps[rng.integers(len(preps))]
-            forms = [
-                f"a {a} {s} is {v} {p} the {o}",
-                f"the {s} is {v} {p} a {o}",
-                f"a {s} {v} {p} the {o}",
-                f"the {a} {s} is {v}",
-                f"a {s} is {v} {p} the {o}",
-            ]
-            caps = [forms[j % len(forms)]
-                    for j in range(spec.captions_per_video)]
+            canonical = f"a {s} is {v} {p} the {o}"
+            caps = []
+            for j in range(spec.captions_per_video):
+                if j % 5 < 3:          # 60%: the consensus form
+                    caps.append(canonical)
+                elif j % 5 == 3:       # 20%: shortened variant
+                    caps.append(f"the {s} is {v}")
+                else:                  # 20%: noisy variant, per-caption
+                    a = adjs[rng.integers(len(adjs))]       # random extras
+                    a2 = adjs[rng.integers(len(adjs))]
+                    caps.append(f"the {a} {s} is {v} {p} a {a2} {o}")
             all_caps.append(caps)
         return all_caps
     all_caps = []

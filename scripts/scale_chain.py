@@ -33,20 +33,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def generate_data(root: str, num_videos: int, num_val: int,
                   feat_dims=(2048, 4096), feat_times=(28, 1),
-                  rich_vocab: int = 8000):
+                  rich_vocab: int = 8000, guard_dir: str | None = None):
     from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
     from cst_captioning_tpu.data.vocab import load_vocab
 
     marker = os.path.join(root, "SCALE_SPEC.json")
     spec_dict = {"num_videos": num_videos, "num_val": num_val,
                  "feat_dims": list(feat_dims), "feat_times": list(feat_times),
-                 "rich_vocab": rich_vocab, "v": 3}
+                 "rich_vocab": rich_vocab, "v": 4}  # v4 = consensus-gap grammar
     if os.path.exists(marker) and os.path.exists(marker + ".paths"):
         with open(marker) as f:
             if json.load(f) == spec_dict:
                 print(f"reusing dataset in {root}")
                 with open(marker + ".paths") as f:
                     return json.load(f)
+        # Spec/grammar changed: checkpoints trained on the OLD dataset
+        # must not silently chain against regenerated data (different
+        # vocab size/word-id mapping -> shape crash, or worse, scrambled
+        # embeddings with garbage metrics).  Refuse; the operator picks a
+        # fresh --out_dir or deletes the stale checkpoints deliberately.
+        if guard_dir and os.path.isdir(guard_dir) and os.listdir(guard_dir):
+            raise SystemExit(
+                f"dataset spec changed but {guard_dir} holds checkpoints "
+                "trained on the previous dataset; use a fresh --out_dir "
+                "(or delete the old checkpoints) instead of mixing them")
     os.makedirs(root, exist_ok=True)
     t0 = time.time()
     spec = SyntheticSpec(
@@ -85,11 +95,16 @@ def main() -> int:
     p.add_argument("--xe_epochs", type=int, default=80)
     p.add_argument("--wxe_epochs", type=int, default=20)
     p.add_argument("--cst_epochs", type=int, default=25)
-    p.add_argument("--patience", type=int, default=8,
+    p.add_argument("--patience", type=int, default=15,
                    help="early-stop patience for XE/WXE (0 = off); CST "
                         "stages always run their full epoch budget so the "
-                        "learning curves are complete")
-    p.add_argument("--lr_decay_every", type=int, default=15,
+                        "learning curves are complete.  Generous default: "
+                        "synthetic epochs are tiny (20 steps at 640 "
+                        "videos) and greedy-decode val scores plateau in "
+                        "EXACT ties, so short patience fires early "
+                        "(round-4 midscale probe stopped XE at 16/100 "
+                        "epochs, well short of convergence)")
+    p.add_argument("--lr_decay_every", type=int, default=25,
                    help="staircase decay period in epochs for XE/WXE "
                         "(the 640-video synthetic has ~1/10 the steps of "
                         "real MSR-VTT epochs, so decay slower than the "
@@ -117,7 +132,7 @@ def main() -> int:
     paths = generate_data(root, args.num_videos, args.num_val,
                           feat_dims=args.feat_dims,
                           feat_times=args.feat_times,
-                          rich_vocab=args.rich_vocab)
+                          rich_vocab=args.rich_vocab, guard_dir=ckpt)
     train, val = paths["train"], paths["val"]
 
     common = [
