@@ -31,7 +31,7 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test xe wxe cst cst_scb cst_host eval bench demo clean
+.PHONY: test xe wxe cst cst_scb cst_host eval bench demo scale_chain clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -103,6 +103,13 @@ bench:
 
 demo:
 	$(PY) scripts/demo.py --out_dir /tmp/cst_demo
+
+# MSR-VTT-scale synthetic chain (640 videos x 20 captions, ~8k vocab,
+# ResNet+C3D shapes): XE-to-convergence -> WXE -> CST (fused rewards) ->
+# beam-5 eval, stage-resumable.  scripts/scale_chain.py --help for knobs.
+scale_chain:
+	$(PY) scripts/scale_chain.py --out_dir /tmp/cst_scale \
+	  --stages xe,wxe,cst,cst_scb_sample,eval
 
 clean:
 	rm -rf $(OUT)
