@@ -61,6 +61,12 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
                         "GB PER DEVICE (the table is full-size on every "
                         "device regardless of mesh shape), instead of an "
                         "opaque device OOM mid-epoch")
+    g.add_argument("--device_feats_upload_mb", type=float, default=64.0,
+                   help="row-chunk size for the --device_feats table upload: "
+                        "each host->device transfer stays under this many MB "
+                        "(one monolithic multi-hundred-MB device_put wedged "
+                        "a remote-tunnel transport; chunking also bounds "
+                        "host RAM to ~one chunk and logs upload progress)")
     g.add_argument("--preload_feats", type=int, default=0,
                    help="1 = read all feature h5s into host RAM at startup "
                         "(removes per-batch disk IO; needs dataset-sized RAM)")

@@ -82,6 +82,56 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
     )
 
 
+def upload_table_chunked(read_fn, n: int, shapes, dtype, sharding,
+                         upload_mb: float = 64.0):
+    """Build per-modality device-resident tables ``[(n, t, d), ...]`` by
+    reading and uploading bounded row chunks.
+
+    ``read_fn(ix)`` returns one host array per modality for the given row
+    indices (``CaptionDataset.features``).  Each chunk is ``device_put``
+    separately and written into a donated device buffer with
+    ``lax.dynamic_update_slice`` — peak HBM is table + one chunk (never
+    2x table, as a device-side concatenate would transiently cost), peak
+    host memory is one chunk per modality, and no single transfer exceeds
+    ``upload_mb`` (huge monolithic transfers wedged a remote TPU tunnel
+    whose streaming path is reliable).  Per-chunk completion barriers keep
+    at most one chunk in flight so progress is observable and bounded.
+    """
+    import functools
+
+    from jax import lax
+
+    jdtype = (jax.numpy.float32 if dtype is None
+              else jax.numpy.dtype(dtype))
+    row_bytes = [t * d * np.dtype(dtype or np.float32).itemsize
+                 for t, d in shapes]
+    chunk_rows = max(1, int(upload_mb * 1e6 // max(row_bytes)))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _write(buf, chunk, start):
+        return lax.dynamic_update_slice(
+            buf, chunk, (start,) + (0,) * (buf.ndim - 1))
+
+    def _zeros(t, d):
+        return jax.jit(
+            lambda: jax.numpy.zeros((n, t, d), jdtype),
+            out_shardings=sharding)()
+
+    tables = [_zeros(t, d) for t, d in shapes]
+    n_chunks = -(-n // chunk_rows)
+    for i, start in enumerate(range(0, n, chunk_rows)):
+        ix = np.arange(start, min(start + chunk_rows, n))
+        for m, arr in enumerate(read_fn(ix)):
+            if dtype is not None:
+                arr = np.asarray(arr, dtype=dtype)
+            chunk = jax.device_put(arr, sharding)
+            tables[m] = _write(tables[m], chunk, np.int32(start))
+        jax.block_until_ready(tables)
+        if n_chunks > 1 and ((i + 1) % 8 == 0 or i + 1 == n_chunks):
+            log.info("device_feats upload: %d/%d chunks", i + 1, n_chunks)
+    return tables
+
+
 def _split_paths(opt, split: str) -> Optional[SplitPaths]:
     feat = getattr(opt, f"{split}_feat_h5", None)
     label = getattr(opt, f"{split}_label_h5", None)
@@ -333,9 +383,13 @@ class Trainer:
         guard below fails at startup with the table size instead of letting
         a pod run die in an opaque device OOM mid-epoch.
 
-        Reads in chunks into a preallocated final-dtype array so transient
-        host memory stays ~one chunk per modality, not several full-dataset
-        copies."""
+        Reads and uploads in bounded row chunks (``upload_table_chunked``)
+        so (a) transient host memory stays ~one chunk per modality, never a
+        full-dataset copy, and (b) no single host->device transfer exceeds
+        ``--device_feats_upload_mb`` — one monolithic multi-hundred-MB
+        ``device_put`` was observed to wedge a remote-tunnel transport that
+        streams per-batch transfers indefinitely, and chunked uploads also
+        give loggable progress instead of a silent multi-minute stall."""
         from ..parallel import replicated_sharding
 
         if getattr(self.opt, "preload_feats", 0):
@@ -355,21 +409,13 @@ class Trainer:
                 f"--device_feats_max_gb {budget / 1e9:.1f} GB budget — "
                 "use --device_feats 0 (streamed prefetch) or raise the "
                 "budget if the chip's HBM actually fits it")
-        tables_np = [
-            np.empty((n, t, d), dtype or np.float32) for t, d in shapes
-        ]
-        chunk = 512
-        for start in range(0, n, chunk):
-            ix = np.arange(start, min(start + chunk, n))
-            for m, arr in enumerate(self.train_ds.features(ix)):
-                tables_np[m][start:start + len(ix)] = arr
-        tables = [
-            jax.device_put(a, replicated_sharding(self.mesh))
-            for a in tables_np
-        ]
-        total = sum(a.nbytes for a in tables_np)
+        tables = upload_table_chunked(
+            self.train_ds.features, n, shapes, dtype,
+            replicated_sharding(self.mesh),
+            upload_mb=float(getattr(self.opt, "device_feats_upload_mb", 64.0)),
+        )
         log.info("device_feats: %d videos x %d modalities pinned in HBM "
-                 "(%.2f GB%s)", n, len(tables), total / 1e9,
+                 "(%.2f GB%s)", n, len(tables), table_bytes / 1e9,
                  ", bf16" if dtype is not None else "")
         return tables
 

@@ -118,6 +118,11 @@ def main() -> int:
                    help="probe-validated: 5e-5 destabilized REINFORCE "
                         "from a converged warm start; 2e-5 was stable")
     p.add_argument("--device_rewards", default="1")
+    p.add_argument("--device_feats", default="1",
+                   help="0 streams features per batch via the prefetch "
+                        "thread — the safer path over a flaky remote "
+                        "tunnel, where the full-table HBM upload's bulk "
+                        "transfers have wedged the transport")
     p.add_argument("--rnn_size", type=int, default=512)
     p.add_argument("--rich_vocab", type=int, default=8000)
     p.add_argument("--feat_dims", type=int, nargs="+", default=[2048, 4096])
@@ -148,7 +153,7 @@ def main() -> int:
         "--rnn_size", str(args.rnn_size),
         "--input_encoding_size", str(args.rnn_size),
         "--att_size", str(args.rnn_size), "--max_length", "30",
-        "--use_bfloat16", "1", "--device_feats", "1",
+        "--use_bfloat16", "1", "--device_feats", args.device_feats,
         "--save_every_steps", "100",  # tunnel-wedge recovery granularity
         "--log_every", "10", "--fast_val", "1",
     ]
