@@ -22,7 +22,7 @@ Transformer decoder (driver config 5) behind the same four surfaces.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Callable, List, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -63,6 +63,12 @@ class CaptionModel(nn.Module):
                                     # attention instead of storing (L,B,T,A)
                                     # f32 residuals (HBM-traffic trade;
                                     # measured on TPU in PARITY.md)
+    encode_constraint: Callable | None = None
+                                    # context parallelism: applied to the
+                                    # encoder memory (B, T, H) right after
+                                    # encode — parallel.cp.time_shard_memory
+                                    # keeps T sharded over the model axis
+                                    # through the decoder's cross-attention
 
     def setup(self):
         self.encoder = FeatureEncoder(self.hidden_size, self.dropout_rate,
@@ -118,6 +124,8 @@ class CaptionModel(nn.Module):
     def encode(self, feats: Sequence[jnp.ndarray], train: bool = False):
         """-> (memory (B,T,H), proj_mem (B,T,A), pooled (B,H))."""
         memory, pooled = self.encoder(feats, train=train)
+        if self.encode_constraint is not None:
+            memory = self.encode_constraint(memory)
         if self.decoder_type == "lstm":
             proj_mem = self.memory_proj(memory)
         else:

@@ -11,21 +11,40 @@ than a translation of NCCL calls.
 
 from .mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     batch_sharding,
     host_local_slice,
     make_mesh,
     replicated_sharding,
     shard_batch_arrays,
 )
+from .cp import context_parallel_jit, time_shard_memory
 from .dp import data_parallel_jit, distributed_init
+from .sequence import (
+    ring_cross_attention,
+    sp_additive_attention,
+    sp_cross_attention_jit,
+    sp_dot_attention,
+    sp_multihead_cross_attention,
+    time_sharding,
+)
 
 __all__ = [
     "DATA_AXIS",
+    "MODEL_AXIS",
     "batch_sharding",
+    "context_parallel_jit",
     "data_parallel_jit",
     "distributed_init",
     "host_local_slice",
     "make_mesh",
     "replicated_sharding",
+    "ring_cross_attention",
     "shard_batch_arrays",
+    "sp_additive_attention",
+    "sp_cross_attention_jit",
+    "sp_dot_attention",
+    "sp_multihead_cross_attention",
+    "time_shard_memory",
+    "time_sharding",
 ]

@@ -1,0 +1,108 @@
+"""Context-parallel (time-sharded) training step compilation.
+
+``context_parallel_jit`` is the GSPMD companion to the explicit
+``parallel.sequence`` ops: the train step is jitted with long feature
+modalities sharded ``(batch -> data, time -> model)`` and the encoder
+memory constrained to stay time-sharded, so XLA's partitioner keeps the
+``(B, T, H)`` memory distributed over the ``model`` axis and inserts the
+cross-attention / pooling / gradient collectives itself.  Gradient
+bookkeeping (which parameter grads are partial sums over the time axis
+vs already-replicated) is exactly what GSPMD's global-view semantics
+solve automatically — the reason this path is annotation-driven while
+``parallel/sequence.py`` keeps the explicit shard_map form for
+guaranteed-peak-memory attention.
+
+Usage (ActivityNet-length streams, driver config 5):
+
+    mesh = make_mesh(model_parallel=k)            # (data, model=k)
+    step = context_parallel_jit(
+        make_xe_step(model, S), mesh,
+        feats_time_sharded=(True, False))          # I3D stream, clip feat
+
+with the model built with ``time_shard_memory(mesh)`` as its
+``encode_constraint`` so the fused memory keeps the time sharding through
+the decoder blocks.
+
+Reference counterpart: none — the reference mean-pools time away before
+its decoder and has no sequence parallelism (SURVEY.md §5 long-context);
+this module is the rebuild's CP answer for the config-5 scale.
+Equivalence to the unsharded step is pinned by
+tests/test_sequence_parallel.py::test_context_parallel_xe_step_*.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, replicated_sharding
+
+
+def time_shard_memory(mesh: Mesh) -> Callable:
+    """``encode_constraint`` for CaptionModel: keep the encoder memory
+    ``(B, T, H)`` sharded (batch over data, time over model) through the
+    decoder's cross-attention instead of letting the partitioner gather
+    it onto every device."""
+    sh = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+
+    def constrain(memory):
+        return jax.lax.with_sharding_constraint(memory, sh)
+
+    return constrain
+
+
+def context_parallel_jit(
+    step_fn: Callable,
+    mesh: Mesh,
+    feats_time_sharded: Sequence[bool],
+    batch_argnums=(1,),
+    feats_argnum: int = 1,
+    donate_argnums=(0,),
+) -> Callable:
+    """jit ``step_fn`` with DP + CP shardings.
+
+    Like ``data_parallel_jit`` (state replicated, batch args sharded on
+    ``data``, outputs replicated) except the ``feats_argnum`` argument is
+    a per-modality list whose entries with ``feats_time_sharded[m]`` True
+    are additionally sharded over ``model`` on their time axis.  Short
+    modalities (e.g. a single clip-level vector) stay time-replicated.
+
+    Divisibility: each sharded modality's T — and, when the model uses
+    ``time_shard_memory``, the *concatenated* memory T (sum of all
+    modality T's) — must divide the model-axis size; pad the feature
+    stream to a multiple otherwise (long-stream loaders already pad to
+    fixed T).  Violations fail at compile time with the offending shape.
+    """
+    b = batch_sharding(mesh)
+    r = replicated_sharding(mesh)
+    t = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+    feats_sh = [t if s else b for s in feats_time_sharded]
+
+    def in_sh(n):
+        out = []
+        for i in range(n):
+            if i == feats_argnum:
+                out.append(feats_sh)
+            elif i in batch_argnums:
+                out.append(b)
+            else:
+                out.append(r)
+        return tuple(out)
+
+    compiled = {}
+
+    def wrapped(*args):
+        fn = compiled.get(len(args))
+        if fn is None:
+            fn = jax.jit(
+                step_fn,
+                in_shardings=in_sh(len(args)),
+                out_shardings=r,
+                donate_argnums=donate_argnums,
+            )
+            compiled[len(args)] = fn
+        return fn(*args)
+
+    return wrapped

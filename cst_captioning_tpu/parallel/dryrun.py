@@ -126,6 +126,28 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
     video_ix = shard_batch_arrays(mesh, jnp.arange(B, dtype=jnp.int32))
     state, fused_metrics = fused(state, feats, video_ix, key)
 
+    # -- sequence/context parallelism: time-sharded cross-attention --------
+    # A second mesh over the SAME devices with a model axis carries the
+    # long-stream path (driver config 5 shapes, scaled down): encoder
+    # memory (B, T, H) lives time-sharded and the decoder's cross-
+    # attention combines blockwise — no device ever holds full T.
+    sp_ctx_sum = None
+    if n_devices % 2 == 0 and n_devices >= 2:
+        from cst_captioning_tpu.parallel.sequence import (
+            sp_cross_attention_jit,
+            time_sharding,
+        )
+
+        sp_mesh = make_mesh(devices, model_parallel=2)
+        t_long = 64
+        bq = sp_mesh.shape["data"] * 2
+        kv = jnp.asarray(
+            rng.standard_normal((bq, t_long, HIDDEN)), jnp.float32)
+        kv = jax.device_put(kv, time_sharding(sp_mesh))
+        qq = jnp.asarray(rng.standard_normal((bq, 4, HIDDEN)), jnp.float32)
+        ctx = sp_cross_attention_jit(sp_mesh)(qq, kv, kv)
+        sp_ctx_sum = float(jnp.sum(ctx))
+
     return {
         "mesh_shape": dict(mesh.shape),
         "xe_losses": xe_losses,
@@ -134,5 +156,6 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
         "rl_loss": float(rl_metrics["loss"]),
         "fused_loss": float(fused_metrics["loss"]),
         "fused_reward": float(fused_metrics["reward"]),
+        "sp_ctx_sum": sp_ctx_sum,
         "params": jax.device_get(state.params),
     }

@@ -1,0 +1,235 @@
+"""Sequence/context parallelism: attention over a time-sharded encoder.
+
+ActivityNet-length feature streams (driver config 5; minutes of video at
+frame rate) make the encoder memory ``(B, T, H)`` the largest live tensor
+— at T in the tens of thousands it stops fitting comfortably next to the
+training step's other buffers, and the reference (which mean-pools T away
+— SURVEY.md §5 "long-context") has nothing to imitate.  The TPU-native
+answer: leave the memory sharded over a mesh axis along T and give the
+decoder's cross-attention a blockwise online-softmax combine over that
+axis, so the full T never materializes on any device.
+
+Design notes:
+
+- These are the *explicit* collective forms (``shard_map`` + ``pmax`` /
+  ``psum``), not GSPMD annotations: a softmax over a sharded axis is
+  exactly the case where XLA's partitioner may insert an all-gather of
+  the sharded operand, which defeats the point.  The online combine
+  guarantees per-device peak memory of one local block.
+- Cross-attention (short decoder query, long encoder memory) wants the
+  combine schedule, not a ring: every device holds its own K/V block
+  once, computes its partial softmax statistics, and one ``psum`` merges
+  them.  A ring (``ppermute`` rotating K/V blocks) pays (shards-1)
+  communication hops to compute the same thing and only wins when Q is
+  sharded over the SAME axis as K/V (self-attention over the long
+  sequence), which this model family does not have — the decoder's
+  self-attention is over <=30 caption tokens.  ``ring_cross_attention``
+  below implements the ring schedule anyway (hop-pipelined, same
+  numerics) both as the scaling path for memory-bound blocks and as an
+  independent check on the combine version.
+- The math is the standard streaming-softmax merge: each shard computes
+  local max m_i, rescaled exp-sum s_i and context numerator n_i; the
+  global result is softmax-combined via m = pmax(m_i),
+  s = psum(s_i * exp(m_i - m)), ctx = psum(n_i * exp(m_i - m)) / s.
+  Scores are computed in f32 regardless of storage dtype (the same
+  decision as ops/attention.py and the Pallas kernel).
+
+Reference counterpart: none — the reference has no sequence parallelism
+(SURVEY.md §2 parallelism table); this module is the rebuild's "SP/CP"
+row.  Equivalence to single-device attention is pinned to 1e-5 by
+tests/test_sequence_parallel.py on the 8-device CPU mesh, including
+ragged T with padding masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+_NEG_INF = -1e30  # finite "masked" score: keeps pmax/exp NaN-free when a
+                  # whole shard (or a whole row) is padding
+
+
+def time_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, T, ...) arrays: batch over ``data``, time over ``model``."""
+    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+
+
+def _combine(scores: jnp.ndarray, values: jnp.ndarray, axis_name: str,
+             contract: str):
+    """Streaming-softmax combine of per-shard attention blocks.
+
+    scores: local f32 attention logits with Tl last (already masked);
+    values: local value block; ``contract`` is the einsum folding the
+    exp'd scores with values into the local context numerator (e.g.
+    ``"bqt,btd->bqd"`` for dot attention, ``"bt,bth->bh"`` for additive).
+    """
+    m_local = jnp.max(scores, axis=-1)
+    m = jax.lax.pmax(m_local, axis_name)
+    e = jnp.exp(scores - m[..., None])
+    s = jax.lax.psum(jnp.sum(e, axis=-1), axis_name)
+    n = jnp.einsum(contract, e, values)
+    ctx = jax.lax.psum(n, axis_name) / jnp.maximum(s, 1e-30)[..., None]
+    return ctx, s, m
+
+
+def sp_dot_attention(
+    q: jnp.ndarray,            # (B, Lq, D) queries (full, replicated on axis)
+    k: jnp.ndarray,            # (B, Tl, D) LOCAL key block
+    v: jnp.ndarray,            # (B, Tl, Dv) LOCAL value block
+    *,
+    axis_name: str,
+    mask: Optional[jnp.ndarray] = None,   # (B, Tl) True = attend
+) -> jnp.ndarray:
+    """Scaled dot-product cross-attention over a time-sharded memory.
+
+    Call inside ``shard_map`` with K/V sharded on ``axis_name``; returns
+    the (B, Lq, Dv) context, identical on every shard of the axis.
+    Multi-head callers fold heads into the batch dim (see
+    ``sp_multihead_cross_attention``).
+    """
+    scores = jnp.einsum(
+        "bqd,btd->bqt", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(q.shape[-1]))
+    if mask is not None:
+        scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    ctx, _, _ = _combine(scores, v.astype(jnp.float32), axis_name,
+                         "bqt,btd->bqd")
+    return ctx.astype(v.dtype)
+
+
+def sp_additive_attention(
+    q_proj: jnp.ndarray,            # (B, A) projected decoder query
+    memory: jnp.ndarray,            # (B, Tl, H) LOCAL memory block
+    projected_memory: jnp.ndarray,  # (B, Tl, A) LOCAL W_m . memory block
+    score_v: jnp.ndarray,           # (A,) score vector
+    *,
+    axis_name: str,
+    mask: Optional[jnp.ndarray] = None,   # (B, Tl) True = attend
+) -> jnp.ndarray:
+    """Additive (Bahdanau) attention over a time-sharded memory — the
+    SP form of ``ops.attention.AdditiveAttention``'s score -> softmax ->
+    context chain (same f32 casts), for the attention-LSTM decoder.
+    Returns the (B, H) context."""
+    scores = jnp.einsum(
+        "bta,a->bt",
+        jnp.tanh(projected_memory.astype(jnp.float32)
+                 + q_proj.astype(jnp.float32)[:, None, :]),
+        score_v.astype(jnp.float32),
+    )
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    ctx, _, _ = _combine(scores, memory.astype(jnp.float32), axis_name,
+                         "bt,bth->bh")
+    return ctx.astype(memory.dtype)
+
+
+def ring_cross_attention(
+    q: jnp.ndarray,            # (B, Lq, D)
+    k: jnp.ndarray,            # (B, Tl, D) LOCAL block
+    v: jnp.ndarray,            # (B, Tl, Dv) LOCAL block
+    *,
+    axis_name: str,
+    mask: Optional[jnp.ndarray] = None,   # (B, Tl)
+) -> jnp.ndarray:
+    """Ring-scheduled equivalent of ``sp_dot_attention``: K/V blocks hop
+    around the axis via ``ppermute`` while each device folds one block per
+    hop into its running (max, sum, numerator) — communication overlaps
+    compute hop by hop and no collective touches the full T.  Numerics
+    match the combine version exactly (same f32 streaming-softmax merge);
+    preferred when even the psum of the (B, Lq, Dv) numerator is a
+    concern, or as the building block for future Q-sharded self-attention
+    over long streams."""
+    n_shards = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    qf = q.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+
+    def block_stats(kb, vb, mb):
+        s = jnp.einsum("bqd,btd->bqt", qf, kb.astype(jnp.float32)) * scale
+        if mb is not None:
+            s = jnp.where(mb[:, None, :], s, _NEG_INF)
+        m = jnp.max(s, axis=-1)                               # (B, Lq)
+        e = jnp.exp(s - m[..., None])
+        return m, jnp.sum(e, axis=-1), jnp.einsum(
+            "bqt,btd->bqd", e, vb.astype(jnp.float32))
+
+    def merge(acc, blk):
+        m0, s0, n0 = acc
+        m1, s1, n1 = blk
+        m = jnp.maximum(m0, m1)
+        a0, a1 = jnp.exp(m0 - m), jnp.exp(m1 - m)
+        return m, s0 * a0 + s1 * a1, n0 * a0[..., None] + n1 * a1[..., None]
+
+    mask_f = (jnp.ones(k.shape[:2], jnp.float32) if mask is None
+              else mask.astype(jnp.float32))
+    acc = block_stats(k, v, mask_f > 0.5)
+    kb, vb, mb = k, v, mask_f
+    for _ in range(n_shards - 1):
+        kb, vb, mb = (jax.lax.ppermute(x, axis_name, perm)
+                      for x in (kb, vb, mb))
+        acc = merge(acc, block_stats(kb, vb, mb > 0.5))
+    m, s, n = acc
+    ctx = n / jnp.maximum(s, 1e-30)[..., None]
+    return ctx.astype(v.dtype)
+
+
+def sp_multihead_cross_attention(
+    q: jnp.ndarray,            # (B, Lq, nH, Dh)
+    k: jnp.ndarray,            # (B, Tl, nH, Dh) LOCAL block
+    v: jnp.ndarray,            # (B, Tl, nH, Dh) LOCAL block
+    *,
+    axis_name: str,
+    mask: Optional[jnp.ndarray] = None,   # (B, Tl)
+    ring: bool = False,
+) -> jnp.ndarray:
+    """Multi-head wrapper: folds heads into batch, runs the SP attention,
+    unfolds.  Same layout as ``nn.MultiHeadDotProductAttention``'s
+    post-projection q/k/v."""
+    b, lq, nh, dh = q.shape
+    tl = k.shape[1]
+    fold = lambda x, L: x.transpose(0, 2, 1, 3).reshape(b * nh, L, dh)
+    qf, kf, vf = fold(q, lq), fold(k, tl), fold(v, tl)
+    mf = None if mask is None else jnp.repeat(mask, nh, axis=0)
+    fn = ring_cross_attention if ring else sp_dot_attention
+    ctx = fn(qf, kf, vf, axis_name=axis_name, mask=mf)
+    return ctx.reshape(b, nh, lq, dh).transpose(0, 2, 1, 3)
+
+
+def sp_cross_attention_jit(mesh: Mesh, ring: bool = False):
+    """Convenience global-array form: shard_map-wrap ``sp_dot_attention``
+    over ``mesh`` — q sharded on batch only, k/v on (batch, time); the
+    returned callable consumes/produces global arrays, so callers can use
+    it without writing shard_map themselves."""
+    fn = partial(ring_cross_attention if ring else sp_dot_attention,
+                 axis_name=MODEL_AXIS)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS, MODEL_AXIS),
+                  P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
+        out_specs=P(DATA_AXIS),
+        # The ring's hop-accumulated context is replicated over the model
+        # axis by construction (every device folds every block), but that
+        # is invisible to the static varying-axes check — the combine
+        # version's psum proves it, the ring's ppermute loop cannot.
+        check_vma=not ring,
+    )
+    def mapped(q, k, v, mask):
+        return fn(q, k, v, mask=mask)
+
+    jitted = jax.jit(mapped)
+
+    def call(q, k, v, mask=None):
+        if mask is None:
+            mask = jnp.ones(k.shape[:2], dtype=bool)
+        return jitted(q, k, v, mask)
+
+    return call

@@ -1,0 +1,229 @@
+"""Sequence/context parallelism: time-sharded attention equivalence.
+
+parallel/sequence.py computes cross-attention over an encoder memory whose
+T axis is sharded over the mesh ``model`` axis, via streaming-softmax
+collectives (combine) or a ppermute ring.  The contract: numerically
+equivalent (f32, 1e-5) to plain single-device softmax attention over the
+full T, for any shard count, ragged padding masks included.  The mesh here
+is (data=4, model=2) over the 8 virtual CPU devices from conftest.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.parallel.mesh import make_mesh
+from cst_captioning_tpu.parallel.sequence import (
+    ring_cross_attention,
+    sp_additive_attention,
+    sp_cross_attention_jit,
+    sp_dot_attention,
+    sp_multihead_cross_attention,
+    time_sharding,
+)
+from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    return make_mesh(model_parallel=2)
+
+
+def ref_attention(q, k, v, mask=None):
+    """Single-device full-T softmax attention, f32."""
+    s = np.einsum("bqd,btd->bqt", q, k) / np.sqrt(q.shape[-1])
+    if mask is not None:
+        s = np.where(mask[:, None, :], s, -1e30)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bqt,btd->bqd", w, v)
+
+
+def _rand(rng, *shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("ring", [False, True])
+def test_sp_dot_attention_matches_full_softmax(mesh, ring):
+    rng = np.random.default_rng(0)
+    b, lq, t, d = 8, 5, 48, 16
+    q, k, v = _rand(rng, b, lq, d), _rand(rng, b, t, d), _rand(rng, b, t, d)
+    got = np.asarray(sp_cross_attention_jit(mesh, ring=ring)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, ref_attention(q, k, v), atol=1e-5)
+
+
+@pytest.mark.parametrize("ring", [False, True])
+def test_sp_dot_attention_ragged_mask(mesh, ring):
+    """T not divisible by the axis: pad and mask.  Includes a row whose
+    valid region lives entirely on ONE shard (the other shard fully
+    masked) — the cross-shard combine must zero the dead block."""
+    rng = np.random.default_rng(1)
+    b, lq, t_valid, d = 8, 3, 19, 8
+    shards = mesh.shape["model"]
+    t_pad = -(-t_valid // shards) * shards  # 20
+    q = _rand(rng, b, lq, d)
+    k, v = _rand(rng, b, t_pad, d), _rand(rng, b, t_pad, d)
+    mask = np.zeros((b, t_pad), dtype=bool)
+    mask[:, :t_valid] = True
+    mask[0, :] = False
+    mask[0, :4] = True  # row 0: only the first shard's block has memory
+    got = np.asarray(sp_cross_attention_jit(mesh, ring=ring)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, ref_attention(q, k, v, mask), atol=1e-5)
+
+
+def test_sp_additive_matches_module_math(mesh):
+    """sp_additive_attention == the AdditiveAttention module's
+    score->softmax->context chain on the full memory."""
+    rng = np.random.default_rng(2)
+    b, t, h, a = 8, 24, 12, 10
+    qp = _rand(rng, b, a)
+    mem, pm = _rand(rng, b, t, h), _rand(rng, b, t, a)
+    sv = _rand(rng, a)
+
+    scores = np.einsum("bta,a->bt", np.tanh(pm + qp[:, None, :]), sv)
+    w = np.exp(scores - scores.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    want = np.einsum("bt,bth->bh", w, mem)
+
+    mapped = jax.shard_map(
+        lambda qp, m, p, v: sp_additive_attention(
+            qp, m, p, v, axis_name="model"),
+        mesh=mesh,
+        in_specs=(P("data"), P("data", "model"), P("data", "model"), P()),
+        out_specs=P("data"),
+    )
+    got = np.asarray(mapped(jnp.asarray(qp), jnp.asarray(mem),
+                            jnp.asarray(pm), jnp.asarray(sv)))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_multihead_wrapper_matches_per_head_reference(mesh):
+    rng = np.random.default_rng(3)
+    b, lq, t, nh, dh = 8, 4, 16, 2, 6
+    q = _rand(rng, b, lq, nh, dh)
+    k, v = _rand(rng, b, t, nh, dh), _rand(rng, b, t, nh, dh)
+    want = np.stack([
+        ref_attention(q[:, :, h], k[:, :, h], v[:, :, h])
+        for h in range(nh)
+    ], axis=2)
+
+    mapped = jax.shard_map(
+        lambda q, k, v: sp_multihead_cross_attention(
+            q, k, v, axis_name="model"),
+        mesh=mesh,
+        in_specs=(P("data"), P("data", "model"), P("data", "model")),
+        out_specs=P("data"),
+    )
+    got = np.asarray(mapped(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_ring_equals_combine_bitwise_schedule_invariance(mesh):
+    """Ring and combine schedules compute the same streaming merge; on
+    identical inputs they must agree to float tolerance (not bitwise —
+    the reduction orders differ)."""
+    rng = np.random.default_rng(4)
+    b, lq, t, d = 8, 2, 32, 8
+    q, k, v = (jnp.asarray(_rand(rng, b, lq, d)),
+               jnp.asarray(_rand(rng, b, t, d)),
+               jnp.asarray(_rand(rng, b, t, d)))
+    a = np.asarray(sp_cross_attention_jit(mesh, ring=False)(q, k, v))
+    r = np.asarray(sp_cross_attention_jit(mesh, ring=True)(q, k, v))
+    np.testing.assert_allclose(a, r, atol=1e-6)
+
+
+def test_long_stream_memory_stays_sharded(mesh):
+    """The point of SP: a long-T memory is placed time-sharded and the
+    attention runs without any device ever holding full T.  Checks the
+    input layout (per-device shard size) and the output value."""
+    rng = np.random.default_rng(5)
+    b, lq, t, d = 8, 4, 4096, 16
+    q = _rand(rng, b, lq, d)
+    k, v = _rand(rng, b, t, d), _rand(rng, b, t, d)
+    ks = jax.device_put(jnp.asarray(k), time_sharding(mesh))
+    vs = jax.device_put(jnp.asarray(v), time_sharding(mesh))
+    # each device holds (B/4, T/2, d) — half the time axis, not all of it
+    shard_shape = ks.sharding.shard_shape(ks.shape)
+    assert shard_shape == (b // 4, t // 2, d)
+    got = np.asarray(sp_cross_attention_jit(mesh)(jnp.asarray(q), ks, vs))
+    np.testing.assert_allclose(got, ref_attention(q, k, v), atol=1e-5)
+
+
+def test_context_parallel_xe_step_matches_unsharded(mesh):
+    """GSPMD CP: the full XE train step with the long modality time-sharded
+    over the model axis (parallel/cp.py) must produce the same loss and
+    updated params as the plain unsharded step — XLA owns the collective
+    and gradient bookkeeping, this pins that the annotations describe the
+    same program."""
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.parallel.cp import (
+        context_parallel_jit,
+        time_shard_memory,
+    )
+    from cst_captioning_tpu.training.state import create_train_state
+    from cst_captioning_tpu.training.steps import make_xe_step
+
+    B, S, L, V, H = 8, 2, 6, 40, 16
+    # long stream (time-sharded) + clip-level vectors; both the sharded
+    # modality's T and the concatenated memory T (64) must divide the
+    # model axis (parallel/cp.py docstring)
+    feat_shapes = [(62, 12), (2, 6)]
+    kw = dict(vocab_size=V, embed_size=H, hidden_size=H, attn_size=H,
+              num_layers=1, use_attention=True, dropout_rate=0.0,
+              decoder_type="transformer", num_heads=2, num_tx_layers=1,
+              tx_max_len=L + 1)
+    model_cp = CaptionModel(**kw, encode_constraint=time_shard_memory(mesh))
+    model_ref = CaptionModel(**kw)
+
+    # SGD, not adam: adam normalizes by sqrt(v), turning float-noise-level
+    # differences in near-zero grads into lr-scale sign flips — SGD keeps
+    # the param delta linear in the grads so the tolerance tests grads.
+    import optax
+
+    tx = optax.sgd(1e-2)
+    state0 = create_train_state(
+        model_ref, jax.random.PRNGKey(0), feat_shapes, L, S, tx,
+        batch_size=B)
+
+    rng = np.random.default_rng(7)
+    feats = [jnp.asarray(rng.standard_normal((B,) + s), jnp.float32)
+             for s in feat_shapes]
+    labels = jnp.asarray(rng.integers(1, V, (B * S, L)), jnp.int32)
+    weights = jnp.ones((B * S,), jnp.float32)
+    key = jax.random.PRNGKey(3)
+
+    ref_state, ref_metrics = jax.jit(make_xe_step(model_ref, S))(
+        state0, feats, labels, weights, key)
+
+    state0b = create_train_state(
+        model_cp, jax.random.PRNGKey(0), feat_shapes, L, S, tx,
+        batch_size=B)
+    cp_step = context_parallel_jit(
+        make_xe_step(model_cp, S), mesh,
+        feats_time_sharded=(True, False), batch_argnums=(1, 2, 3))
+    cp_state, cp_metrics = cp_step(state0b, feats, labels, weights, key)
+
+    np.testing.assert_allclose(float(cp_metrics["loss"]),
+                               float(ref_metrics["loss"]), atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5),
+        cp_state.params, ref_state.params)
+
+
+def test_degenerate_single_shard_axis():
+    """model axis of size 1 (the default mesh): SP ops reduce to plain
+    attention — no special-casing needed at call sites."""
+    mesh1 = make_mesh(model_parallel=1)
+    rng = np.random.default_rng(6)
+    b, lq, t, d = 8, 3, 8, 4
+    q, k, v = _rand(rng, b, lq, d), _rand(rng, b, t, d), _rand(rng, b, t, d)
+    got = np.asarray(sp_cross_attention_jit(mesh1)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, ref_attention(q, k, v), atol=1e-5)
