@@ -14,7 +14,26 @@ Single source of truth for that scrub; used by tests/conftest.py and
 
 from __future__ import annotations
 
+import logging
 import os
+
+
+def configure_cli_logging(loglevel: str) -> None:
+    """Install the CLI's root logging config, displacing any pre-existing
+    handler.
+
+    ``logging.basicConfig`` is a no-op when a root handler already exists,
+    and this session's ``.axon_site`` sitecustomize installs one (at
+    WARNING) while registering the PJRT plugin — which silently swallowed
+    every INFO progress line of an in-field training run.  ``--loglevel``
+    is the CLI's contract with the operator, so it wins: ``force=True``
+    removes pre-installed handlers first.
+    """
+    logging.basicConfig(
+        level=getattr(logging, str(loglevel).upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        force=True,
+    )
 
 
 def axon_registered() -> bool:

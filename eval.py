@@ -66,11 +66,10 @@ def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
 
 def main(argv=None) -> int:
     opt = parse_opts(argv)
-    logging.basicConfig(
-        level=getattr(logging, opt.loglevel.upper(), logging.INFO),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
-    from cst_captioning_tpu.utils.platform import enable_compile_cache
+    from cst_captioning_tpu.utils.platform import (configure_cli_logging,
+                                                   enable_compile_cache)
+
+    configure_cli_logging(opt.loglevel)
 
     enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
     paths = SplitPaths(

@@ -1,0 +1,47 @@
+"""Session-quirk guards in utils/platform.py."""
+
+import logging
+
+from cst_captioning_tpu.utils.platform import configure_cli_logging
+
+
+class TestConfigureCliLogging:
+    def _restore(self, handlers, level):
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in handlers:
+            root.addHandler(h)
+        root.setLevel(level)
+
+    def test_displaces_preinstalled_root_handler(self):
+        """A sitecustomize-style pre-installed WARNING handler must not
+        turn the CLI's logging setup into a no-op (the field failure: a
+        whole training run with every INFO progress line swallowed)."""
+        root = logging.getLogger()
+        saved_handlers, saved_level = list(root.handlers), root.level
+        try:
+            self._restore([], logging.WARNING)
+            squelcher = logging.StreamHandler()
+            squelcher.setLevel(logging.WARNING)
+            root.addHandler(squelcher)
+            root.setLevel(logging.WARNING)
+
+            configure_cli_logging("info")
+
+            assert squelcher not in root.handlers
+            assert root.level == logging.INFO
+            assert len(root.handlers) == 1
+            assert logging.getLogger("cst_captioning_tpu.anything").isEnabledFor(
+                logging.INFO)
+        finally:
+            self._restore(saved_handlers, saved_level)
+
+    def test_bad_loglevel_falls_back_to_info(self):
+        root = logging.getLogger()
+        saved_handlers, saved_level = list(root.handlers), root.level
+        try:
+            configure_cli_logging("not-a-level")
+            assert root.level == logging.INFO
+        finally:
+            self._restore(saved_handlers, saved_level)

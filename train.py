@@ -15,23 +15,20 @@ See Makefile for the full three-stage recipe.
 from __future__ import annotations
 
 import json
-import logging
 import sys
 
 from cst_captioning_tpu.opts import parse_opts
 from cst_captioning_tpu.parallel.dp import distributed_init
 from cst_captioning_tpu.training.trainer import Trainer
-from cst_captioning_tpu.utils.platform import enable_compile_cache
+from cst_captioning_tpu.utils.platform import (configure_cli_logging,
+                                               enable_compile_cache)
 
 
 def main(argv=None, return_result: bool = False):
     """CLI entry; ``return_result=True`` returns the summary dict instead
     of the exit code (for driver scripts like scripts/scale_chain.py)."""
     opt = parse_opts(argv)
-    logging.basicConfig(
-        level=getattr(logging, opt.loglevel.upper(), logging.INFO),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    configure_cli_logging(opt.loglevel)
     enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
     distributed_init(opt.coordinator_address,
                      opt.num_processes or None, opt.process_id)
