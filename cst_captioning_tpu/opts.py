@@ -232,6 +232,16 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--save_every_steps", type=int, default=0,
                    help="extra checkpoint every N steps for failure "
                         "recovery (0 = epoch boundaries only)")
+    g.add_argument("--wedge_timeout", type=float, default=0.0,
+                   help="seconds without training-loop progress before the "
+                        "process exits with status 124 for checkpointed "
+                        "resume (utils/watchdog.py). A remote-device "
+                        "transport that wedges mid-step blocks forever in a "
+                        "C++ call no exception can unwind; with "
+                        "--save_every_steps, dying fast and resuming is "
+                        "cheap while hanging costs the whole run. Set above "
+                        "the worst legitimate gap (first remote compile can "
+                        "take minutes); 0 disables")
     g.add_argument("--tensorboard", type=int, default=0,
                    help="1 = write TensorBoard scalars under "
                         "<checkpoint_path>/tb (train metrics + val scores); "

@@ -114,8 +114,14 @@ class CheckpointManager:
         if extra:
             self.infos.update(extra)
         self.infos["last_step"] = int(step)
-        with open(self._infos_path, "w") as f:
+        # Atomic replace: the wedge-recovery paths (watchdog os._exit,
+        # harness SIGKILL) can land mid-write, and a truncated infos.json
+        # would turn the NEXT resume into a json.load crash — the recovery
+        # mechanism bricking the run it exists to save.
+        tmp = self._infos_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(self.infos, f, indent=2, default=str)
+        os.replace(tmp, self._infos_path)
 
     def save_recovery(self, step: int, state) -> None:
         """Periodic crash-recovery save (``--save_every_steps``): keeps only

@@ -65,7 +65,7 @@ def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float,
 
 def _decode_local(
     model, params, loader: CaptionLoader, max_len: int,
-    beam_size: int, length_norm: float, mesh=None,
+    beam_size: int, length_norm: float, mesh=None, beat=None,
 ) -> Tuple[List[str], List[np.ndarray]]:
     """Decode THIS host's loader shard -> (video_ids, token rows), deduped
     of the static-shape wrap padding, in shard (dataset) order."""
@@ -91,6 +91,8 @@ def _decode_local(
     rows: List[np.ndarray] = []
     for batch in loader.iter_eval():
         tokens = np.asarray(jax.device_get(decode(batch.feats)))
+        if beat is not None:
+            beat()  # each fetched batch is watchdog-visible progress
         for vid, row in zip(batch.video_ids, tokens):
             if vid in seen:
                 continue
@@ -159,6 +161,7 @@ def decode_split(
     length_norm: float = 0.0,
     allgather=None,
     mesh=None,
+    beat=None,
 ) -> List[Dict[str, str]]:
     """One ordered pass over the split -> [{"image_id", "caption"}].
 
@@ -166,10 +169,13 @@ def decode_split(
     With ``mesh`` the decode batch shards over the ``data`` axis.  Under
     multi-host (loader.process_count > 1) each host decodes its own shard
     and the shards are all-gathered, so EVERY host returns the full
-    split's predictions in the same order.
+    split's predictions in the same order.  ``beat`` (optional zero-arg
+    callable) is invoked after each decoded batch — the trainer threads
+    its wedge-watchdog heartbeat through so a long validation is not
+    mistaken for a hang.
     """
     ids, rows = _decode_local(model, params, loader, max_len,
-                              beam_size, length_norm, mesh)
+                              beam_size, length_norm, mesh, beat=beat)
     if loader.process_count > 1:
         ids, rows = gather_strided_predictions(
             np.stack(rows), loader.ds.video_ids,
@@ -190,10 +196,11 @@ def eval_split(
     length_norm: float = 0.0,
     scorers: Optional[Sequence[str]] = None,
     mesh=None,
+    beat=None,
 ) -> Tuple[List[Dict[str, str]], Dict[str, float]]:
     """Decode + score one split -> (predictions, metric dict)."""
     preds = decode_split(model, params, loader, vocab, max_len,
                          beam_size=beam_size, length_norm=length_norm,
-                         mesh=mesh)
+                         mesh=mesh, beat=beat)
     scores = language_eval(preds, refs, scorers=scorers)
     return preds, scores

@@ -47,6 +47,7 @@ from ..opts import (
 )
 from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
+from ..utils.watchdog import ProgressWatchdog
 from .checkpoint import CheckpointManager
 from .evaluation import eval_split
 from .pipeline import RewardPipeline
@@ -83,7 +84,7 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
 
 
 def upload_table_chunked(read_fn, n: int, shapes, dtype, sharding,
-                         upload_mb: float = 64.0):
+                         upload_mb: float = 64.0, beat=None):
     """Build per-modality device-resident tables ``[(n, t, d), ...]`` by
     reading and uploading bounded row chunks.
 
@@ -127,6 +128,8 @@ def upload_table_chunked(read_fn, n: int, shapes, dtype, sharding,
             chunk = jax.device_put(arr, sharding)
             tables[m] = _write(tables[m], chunk, np.int32(start))
         jax.block_until_ready(tables)
+        if beat is not None:
+            beat()  # each completed chunk is watchdog-visible progress
         if n_chunks > 1 and ((i + 1) % 8 == 0 or i + 1 == n_chunks):
             log.info("device_feats upload: %d/%d chunks", i + 1, n_chunks)
     return tables
@@ -156,6 +159,29 @@ class Trainer:
 
     def __init__(self, opt):
         self.opt = opt
+        # Armed before ANY backend-touching op (even PRNGKey initializes
+        # the device client, and a wedged transport blocks there): a train
+        # stage launched into an already-dead tunnel must still die with
+        # 124 for the harness to resume, not hang unprotected.
+        # describe() must only read HOST state — fetching e.g.
+        # self.state.step would block on the very transport whose death it
+        # is reporting, and the exit would never happen.
+        self._progress_step = -1  # host-side mirror, updated by the loop
+        self._watchdog = ProgressWatchdog(
+            getattr(opt, "wedge_timeout", 0.0) or 0.0,
+            describe=lambda: ("last loop step %d; checkpoints in %s"
+                              % (self._progress_step, opt.checkpoint_path)),
+        ).start()
+        try:
+            self._init(opt)
+        except BaseException:
+            # A failed constructor must not leave the armed watchdog
+            # ticking toward os._exit in a process that chose to continue
+            # (e.g. a REPL catching the ValueError below).
+            self._watchdog.stop()
+            raise
+
+    def _init(self, opt):
         if opt.eval_metric not in self.KNOWN_EVAL_METRICS:
             # Fail at startup, not after the first epoch's validation
             # silently scores 0.0 forever.
@@ -288,6 +314,7 @@ class Trainer:
         self.reward_computer = None
         if opt.use_rl:
             self._setup_rl()
+        self._watchdog.beat()  # init milestones (uploads, RL tables) done
 
         self._batch_sharding = batch_sharding(self.mesh)
         self.history: Dict[str, Any] = {"val": []}
@@ -413,6 +440,7 @@ class Trainer:
             self.train_ds.features, n, shapes, dtype,
             replicated_sharding(self.mesh),
             upload_mb=float(getattr(self.opt, "device_feats_upload_mb", 64.0)),
+            beat=self._watchdog.beat,
         )
         log.info("device_feats: %d videos x %d modalities pinned in HBM "
                  "(%.2f GB%s)", n, len(tables), table_bytes / 1e9,
@@ -692,7 +720,9 @@ class Trainer:
             length_norm=self.opt.length_norm,
             scorers=scorers,
             mesh=self.mesh,  # decode shards over data axis, no idle chips
+            beat=self._watchdog.beat,  # long val decode is not a wedge
         )
+        self._watchdog.beat()  # host-side scoring done too
         return scores
 
     def train(self) -> Dict[str, Any]:
@@ -734,6 +764,10 @@ class Trainer:
 
         profiling = False
         for step in range(start_step, total_steps):
+            # Each completed loop pass implies the previous dispatch, fetch,
+            # val, and save all returned — one beat covers them all.
+            self._watchdog.beat()
+            self._progress_step = step  # host int, safe for describe()
             if opt.profile_dir:
                 if step == opt.profile_start and not profiling:
                     jax.profiler.start_trace(opt.profile_dir)
@@ -783,6 +817,7 @@ class Trainer:
                                    extra={"opt": vars(opt),
                                           "val_scores": scores,
                                           "patience": patience})
+                    self._watchdog.beat()  # orbax fetch+write completed
                     if opt.max_patience and patience >= opt.max_patience:
                         log.info("early stop: no %s improvement in %d epochs",
                                  opt.eval_metric, patience)
@@ -802,9 +837,19 @@ class Trainer:
         }
 
     def close(self) -> None:
-        if self._tb is not None:
-            self._tb.close()
-        self.ckpt.close()
-        self.train_ds.close()
-        if self.val_ds:
-            self.val_ds.close()
+        try:
+            if self._tb is not None:
+                self._tb.close()
+            # ckpt.close() joins orbax's async writer — a device fetch
+            # that can block on a dead transport, so the watchdog must
+            # outlive it (a false 124 here costs one cheap resume; a hang
+            # costs the chain).
+            self.ckpt.close()
+            self.train_ds.close()
+            if self.val_ds:
+                self.val_ds.close()
+        finally:
+            # Always disarm, even if a close above raised — an embedded
+            # caller that catches the error must not be os._exit'd by a
+            # still-armed watchdog minutes later.
+            self._watchdog.stop()

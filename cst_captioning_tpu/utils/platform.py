@@ -70,32 +70,53 @@ def with_host_device_count(flags: str, n: int) -> str:
     return " ".join(kept)
 
 
-def run_in_group(cmd: list, *, env: dict, cwd: str | None = None,
-                 timeout: float | None = None, stdout=None) -> int:
+def run_in_group(cmd: list, *, env: dict | None = None,
+                 cwd: str | None = None, timeout: float | None = None,
+                 stdout=None, stderr=None,
+                 timeout_info: dict | None = None) -> int:
     """Run ``cmd`` in its own process GROUP with inherited stdio.
 
     On timeout, SIGKILL the whole group — a wedged PJRT tunnel plugin can
     spawn helper processes that outlive a direct-child kill — and return
     124 (the coreutils ``timeout`` convention).  Otherwise return the rc.
+    Any other unwind (KeyboardInterrupt, SystemExit from a signal handler)
+    also group-kills the child: a new-session child never receives the
+    terminal's SIGINT, and an interrupted caller must not leave it running
+    detached against the device.
 
     ``stdout`` may be a FILE object (not a pipe) to capture the child's
     stdout; a file stays safe across the group kill because no reader can
     block on it, unlike a pipe held open by orphaned tunnel helpers.
+
+    ``timeout_info``, if given, gets ``timeout_info["timed_out"]`` set —
+    callers that treat the child's OWN exit 124 differently from a
+    harness-timeout 124 (scripts/scale_chain.py) need the distinction.
     """
     import signal
     import subprocess
 
     proc = subprocess.Popen(cmd, env=env, cwd=cwd, start_new_session=True,
-                            stdout=stdout)
-    try:
-        return proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
+                            stdout=stdout, stderr=stderr)
+
+    def kill_group():
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             proc.kill()
         proc.wait()
-        return 124
+
+    if timeout_info is not None:
+        timeout_info["timed_out"] = False
+    try:
+        try:
+            return proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            if timeout_info is not None:
+                timeout_info["timed_out"] = True
+            return 124
+    finally:
+        if proc.poll() is None:
+            kill_group()
 
 
 def enable_compile_cache(cache_dir: str) -> bool:

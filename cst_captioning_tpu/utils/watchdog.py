@@ -1,0 +1,112 @@
+"""Progress watchdog: turn a wedged device backend into a fast, clean exit.
+
+Operating over a remote-TPU tunnel (this session's axon transport), the
+failure mode is not an exception but a HANG: a step dispatch, transfer, or
+remote compile blocks forever on a dead RPC and the training process sits
+in a futex wait with hours of chip time already invested.  Checkpointed
+recovery (``--save_every_steps`` + auto-resume) makes dying CHEAP — what is
+expensive is not noticing.  The watchdog makes the process die loudly and
+promptly instead: a daemon thread watches a monotonic heartbeat the main
+loop touches at every progress point, and if no beat lands for
+``timeout_s`` seconds it logs CRITICAL state and ``os._exit``\\ s with
+:data:`WEDGE_EXIT_CODE` (124, the coreutils ``timeout`` convention).
+
+``os._exit`` (not ``sys.exit``) is deliberate: the main thread is stuck
+inside a blocking C++ runtime call that Python exceptions cannot unwind,
+and a "graceful" shutdown would block on the very transport that died.
+Everything the run cannot afford to lose is already on disk (orbax
+checkpoints, metrics.jsonl is line-buffered).
+
+Callers that orchestrate stages (scripts/scale_chain.py) treat
+WEDGE_EXIT_CODE — or any failure while the device probe also fails — as
+"environment sick, resume when it heals", and every other exit as a real
+failure to surface.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+#: Exit status for "no progress within the timeout" — matches coreutils
+#: ``timeout(1)`` so shell-level and watchdog-level wedge kills look alike.
+WEDGE_EXIT_CODE = 124
+
+
+class ProgressWatchdog:
+    """Daemon-thread heartbeat monitor.
+
+    ``beat()`` is cheap (one monotonic read + store, no locking — a torn
+    read just delays detection by one poll interval) and safe from any
+    thread.  A ``timeout_s`` of 0 disables the watchdog entirely; every
+    method is then a no-op, so call sites need no conditionals.
+    """
+
+    def __init__(self, timeout_s: float,
+                 describe: Optional[Callable[[], str]] = None,
+                 on_timeout: Optional[Callable[[float], None]] = None):
+        self.timeout_s = float(timeout_s)
+        self._describe = describe or (lambda: "")
+        self._on_timeout = on_timeout or self._die
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ProgressWatchdog":
+        if self.timeout_s > 0 and self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(
+                target=self._run, name="progress-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "ProgressWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- heartbeat ---------------------------------------------------------
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    # -- internals ---------------------------------------------------------
+    def _run(self) -> None:
+        poll = max(1.0, min(30.0, self.timeout_s / 4.0))
+        while not self._stop.wait(poll):
+            gap = time.monotonic() - self._last
+            if gap > self.timeout_s:
+                self._on_timeout(gap)
+                return
+
+    def _die(self, gap: float) -> None:  # pragma: no cover - exits process
+        msg = ("no progress for %.0fs (timeout %.0fs) — device backend "
+               "presumed wedged; exiting %d for checkpointed resume. %s"
+               % (gap, self.timeout_s, WEDGE_EXIT_CODE, self._describe()))
+        # Deliberately NOT log.critical: the wedged main thread may hold
+        # the logging module lock (blocked mid-write to a dead pipe), and
+        # acquiring it here would deadlock the watchdog too.  Write the
+        # last word via the raw fd with O_NONBLOCK so even a full dead
+        # pipe cannot block this thread (no restore needed — the next
+        # line ends the process), then exit unconditionally.
+        try:
+            import fcntl
+
+            fl = fcntl.fcntl(2, fcntl.F_GETFL)
+            fcntl.fcntl(2, fcntl.F_SETFL, fl | os.O_NONBLOCK)
+        except Exception:
+            pass
+        try:
+            os.write(2, ("WATCHDOG: " + msg + "\n").encode())
+        except Exception:
+            pass
+        os._exit(WEDGE_EXIT_CODE)

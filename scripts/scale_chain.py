@@ -18,6 +18,16 @@ Usage (full chain):            python scripts/scale_chain.py --out_dir DIR
 One stage (e.g. after wedge):  python scripts/scale_chain.py --out_dir DIR \
                                    --stages cst
 SCB variant of the CST stage:  --stages cst_scb
+
+Wedge recovery: every stage runs as a SUBPROCESS with the trainer's
+``--wedge_timeout`` watchdog armed, so a wedged remote-device transport
+kills the stage (exit 124) instead of hanging it.  The harness then polls
+the device with fresh probe processes until the transport heals and
+re-runs the stage, which auto-resumes from its newest checkpoint (the
+2026-07-31 field pattern: the tunnel flaps on a scale of tens of minutes
+to hours, and a chain left unattended must survive that).  A stage that
+fails while the device probe SUCCEEDS is a real failure and aborts the
+chain — retrying can only hide it.
 """
 
 from __future__ import annotations
@@ -25,10 +35,192 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cst_captioning_tpu.utils.platform import run_in_group  # noqa: E402
+from cst_captioning_tpu.utils.watchdog import WEDGE_EXIT_CODE  # noqa: E402
+
+
+def probe_device(timeout_s: float = 120.0,
+                 env: dict | None = None) -> tuple[str, str]:
+    """Can a FRESH process initialize the default jax backend right now?
+
+    A new process is the only honest probe: the wedged client in a stuck
+    stage never recovers in place, and this parent must not touch the
+    backend itself (a wedged init would hang the harness too).  ``env``
+    must match the environment the stages run under — probing a different
+    backend than the stages use answers the wrong question.
+
+    Returns ``(verdict, detail)`` with verdict one of:
+    - ``"ok"``     — backend initializes;
+    - ``"wedged"`` — init hung or failed while plain ``import jax`` works:
+      waiting may heal it;
+    - ``"broken"`` — the interpreter/env itself is dead (import fails):
+      no amount of waiting helps, surface it immediately.
+    """
+    def grouped(py_code: str) -> tuple[int, bool, str]:
+        """(rc, timed_out, stderr tail) — run_in_group so a hung probe's
+        whole tree (tunnel helper processes included) is SIGKILLed, not
+        just the direct python child; stderr goes through a temp FILE,
+        which stays safe across the group kill unlike a pipe."""
+        import tempfile
+
+        with tempfile.TemporaryFile(mode="w+") as ef:
+            info: dict = {}
+            rc = run_in_group([sys.executable, "-c", py_code],
+                              env=env, cwd=REPO, timeout=timeout_s,
+                              stdout=subprocess.DEVNULL, stderr=ef,
+                              timeout_info=info)
+            ef.seek(0)
+            return rc, info["timed_out"], ef.read().strip()[-2000:]
+
+    rc, timed_out, detail = grouped("import jax; jax.devices()")
+    if rc == 0:
+        return "ok", ""
+    if timed_out:
+        return "wedged", f"device probe timed out after {timeout_s:.0f}s"
+    # Fast nonzero: either the backend refused (transient — treat as
+    # wedged) or the environment cannot even import jax (permanent).
+    rc2, timed_out2, detail2 = grouped("import jax")
+    if rc2 == 0 or timed_out2:
+        return "wedged", detail
+    return "broken", detail2 or detail
+
+
+def run_stage(tag: str, cmd: list, *, max_attempts: int,
+              wedge_poll_s: float, max_wedge_wait_s: float,
+              timeout_s: float = 0.0, probe_timeout_s: float = 120.0,
+              env: dict | None = None, fingerprint=None) -> None:
+    """Run ``cmd`` to completion, resuming across device wedges.
+
+    ``max_attempts`` bounds CONSECUTIVE attempts without progress, not
+    total attempts: a long stage checkpointing its way through many tunnel
+    flaps retries indefinitely, while a stage wedging at the same point
+    every time (e.g. a first compile longer than --wedge_timeout) aborts
+    with advice instead of burning attempts x timeout.  ``fingerprint``
+    (optional zero-arg callable) returns any comparable snapshot of the
+    stage's on-disk progress — checkpoint steps, metrics length; without
+    one, every failed attempt counts as no-progress.
+
+    ``timeout_s`` is a harness-side hard cap for commands that have no
+    in-process watchdog (eval); 0 means none.  The subprocess gets its own
+    session so a timeout kill takes the whole process group."""
+    probed_detail = {"printed": False}
+
+    def probe() -> str:
+        verdict, detail = probe_device(probe_timeout_s, env)
+        if verdict == "broken":
+            raise SystemExit(
+                f"stage {tag}: the stage environment cannot even import "
+                f"jax — not a wedge, aborting immediately:\n{detail}")
+        if verdict == "wedged" and detail and not probed_detail["printed"]:
+            # Surface the first probe's actual error once: a deterministic
+            # fast failure (expired credentials, refused endpoint) would
+            # otherwise heal-poll for hours with its cause never shown.
+            probed_detail["printed"] = True
+            print(f"=== {tag}: device probe detail: {detail} ===",
+                  flush=True)
+        return verdict
+
+    healthy_timeouts = 0
+    no_progress = 0
+    last_fp = fingerprint() if fingerprint else None
+    attempt = 0
+    while True:
+        if no_progress >= max_attempts:
+            raise SystemExit(
+                f"stage {tag}: {no_progress} consecutive attempts made no "
+                "on-disk progress while the device stayed healthy — if "
+                "each died at exit 124 at the same point, a legitimate "
+                "phase (first compile/upload, a long eval) likely exceeds "
+                "its timeout (--wedge_timeout for train stages, "
+                "--eval_timeout for eval); raise it rather than retrying")
+        attempt += 1
+        if attempt > 1:
+            print(f"=== {tag}: attempt {attempt} (resume; {no_progress} "
+                  f"healthy attempts since progress, cap {max_attempts}) "
+                  "===", flush=True)
+        # run_in_group owns the kill semantics: own session, group-SIGKILL
+        # on timeout AND on any unwind (Ctrl-C / SIGTERM-as-SystemExit), so
+        # an interrupted harness never leaves a stage holding the device.
+        info: dict = {}
+        rc = run_in_group(cmd, env=env, cwd=REPO,
+                          timeout=timeout_s or None, timeout_info=info)
+        timed_out = info["timed_out"]
+        if rc == 0:
+            return
+        progressed = False
+        if fingerprint:
+            fp = fingerprint()
+            progressed, last_fp = fp != last_fp, fp
+        # One probe decides this attempt's classification; the heal loop
+        # below reuses that verdict for its first wait instead of
+        # immediately spawning a second backend-init probe at a device we
+        # just found wedged.
+        known_wedged = False
+        if timed_out:
+            if probe() == "ok":
+                # Harness-cap timeout while the device probe succeeds:
+                # either a per-connection wedge (fresh connections work,
+                # the stage's own RPC died — retry helps) or a genuinely
+                # too-slow command (commands under timeout_s have no
+                # checkpoint resume, so a retry repeats the identical
+                # run).  One retry distinguishes them; a second
+                # CONSECUTIVE healthy timeout means raise the cap.
+                healthy_timeouts += 1
+                if healthy_timeouts >= 2:
+                    raise SystemExit(
+                        f"stage {tag} exceeded its {timeout_s:.0f}s harness "
+                        "timeout twice in a row while the device probe "
+                        "succeeds — not a wedge; raise the timeout (e.g. "
+                        "--eval_timeout) instead of retrying")
+                if progressed:
+                    no_progress = 0
+                else:
+                    no_progress += 1
+                continue
+            known_wedged = True
+        elif rc != WEDGE_EXIT_CODE:
+            if probe() == "ok":
+                raise SystemExit(
+                    f"stage {tag} failed with rc={rc} while the device "
+                    "probe succeeds — a real failure, not a wedge; "
+                    "aborting")
+            known_wedged = True
+        print(f"=== {tag}: wedge (rc={rc}); polling for the device "
+              f"every {wedge_poll_s:.0f}s ===", flush=True)
+        deadline = time.time() + max_wedge_wait_s
+        healed = False
+        observed_wedged = known_wedged
+        if known_wedged:
+            time.sleep(wedge_poll_s)  # just probed wedged; wait first
+        while time.time() < deadline:
+            if probe() == "ok":
+                healed = True
+                break
+            observed_wedged = True
+            time.sleep(wedge_poll_s)
+        if not healed:
+            raise SystemExit(
+                f"stage {tag}: device did not heal within "
+                f"{max_wedge_wait_s / 3600:.1f}h; giving up")
+        # Attempt accounting AFTER the facts are in: progress resets the
+        # cap; an attempt that died while the device was observably down
+        # proves nothing about the stage and does not count; only
+        # healthy-device, zero-progress attempts (e.g. a deterministic
+        # 124 at the same setup point) approach the cap.
+        if progressed:
+            no_progress, healthy_timeouts = 0, 0
+        elif observed_wedged:
+            healthy_timeouts = 0
+        else:
+            no_progress += 1
 
 
 def generate_data(root: str, num_videos: int, num_val: int,
@@ -128,9 +320,32 @@ def main() -> int:
     p.add_argument("--feat_dims", type=int, nargs="+", default=[2048, 4096])
     p.add_argument("--feat_times", type=int, nargs="+", default=[28, 1])
     p.add_argument("--xe_lr", default="2e-4")
+    p.add_argument("--wedge_timeout", type=float, default=1500.0,
+                   help="trainer watchdog (seconds without loop progress "
+                        "-> exit 124 -> harness resume); must exceed the "
+                        "worst legitimate first-compile stall over the "
+                        "tunnel (~6 min observed at 640 videos). 0 off")
+    p.add_argument("--wedge_poll", type=float, default=180.0,
+                   help="seconds between device probes while wedged")
+    p.add_argument("--max_wedge_wait", type=float, default=6 * 3600.0,
+                   help="give up when the device stays wedged this long")
+    p.add_argument("--max_stage_attempts", type=int, default=4,
+                   help="max CONSECUTIVE attempts without on-disk progress "
+                        "before a stage aborts; attempts that advance the "
+                        "stage's checkpoints reset the count, so a long "
+                        "run surviving many tunnel flaps is never capped")
+    p.add_argument("--eval_timeout", type=float, default=3600.0,
+                   help="hard cap per eval invocation (eval has no "
+                        "in-process watchdog); 0 = none")
     args = p.parse_args()
-
-    import train as train_cli
+    # Stages run as subprocesses with cwd=REPO; a relative --out_dir must
+    # mean the same directory in the harness and in every stage.
+    args.out_dir = os.path.abspath(args.out_dir)
+    # SIGTERM (scheduler stop, kill <pid>) must unwind like Ctrl-C so
+    # run_in_group's finally can reap the stage child — the default
+    # disposition would kill this harness and orphan the stage against
+    # the device.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
     root = os.path.join(args.out_dir, "data")
     ckpt = os.path.join(args.out_dir, "checkpoints")
@@ -156,6 +371,7 @@ def main() -> int:
         "--use_bfloat16", "1", "--device_feats", args.device_feats,
         "--save_every_steps", "100",  # tunnel-wedge recovery granularity
         "--log_every", "10", "--fast_val", "1",
+        "--wedge_timeout", str(args.wedge_timeout),
     ]
     xe_sched = [
         "--max_patience", str(args.patience),
@@ -164,29 +380,54 @@ def main() -> int:
     ]
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
 
-    def report(tag, res):
-        print(f"=== {tag} done: best {res.get('best_score')} @ step "
-              f"{res.get('best_step')} (last step {res.get('last_step')}) ===",
-              flush=True)
+    def stage_fingerprint(stage_dir):
+        """Snapshot of the stage's on-disk state (paths + sizes): any
+        checkpoint, metrics, or infos write between attempts counts as
+        progress and resets the no-progress attempt cap."""
+        def fp():
+            entries = []
+            for base, _dirs, files in os.walk(stage_dir):
+                for f in files:
+                    p = os.path.join(base, f)
+                    try:
+                        entries.append((p, os.stat(p).st_size))
+                    except OSError:
+                        continue
+            return tuple(sorted(entries))
+        return fp
+
+    def run_train_stage(tag, argv):
+        print(f"=== stage: {tag} ===", flush=True)
+        stage_dir = argv[argv.index("--checkpoint_path") + 1]
+        run_stage(tag, [sys.executable, "train.py", *argv],
+                  max_attempts=args.max_stage_attempts,
+                  wedge_poll_s=args.wedge_poll,
+                  max_wedge_wait_s=args.max_wedge_wait,
+                  fingerprint=stage_fingerprint(stage_dir))
+        try:
+            with open(os.path.join(stage_dir, "infos.json")) as f:
+                infos = json.load(f)
+            print(f"=== {tag} done: best {infos.get('best_score')} @ step "
+                  f"{infos.get('best_step')} ===", flush=True)
+        except (OSError, ValueError):  # report is best-effort only
+            print(f"=== {tag} done ===", flush=True)
 
     if "xe" in stages:
-        print("=== stage: XE pretrain ===", flush=True)
-        report("xe", train_cli.main([
+        run_train_stage("xe", [
             *common, *xe_sched, "--checkpoint_path", f"{ckpt}/xe",
             "--max_epochs", str(args.xe_epochs),
             "--learning_rate", args.xe_lr,
-        ], return_result=True))
+        ])
 
     if "wxe" in stages:
-        print("=== stage: WXE warm-start ===", flush=True)
-        report("wxe", train_cli.main([
+        run_train_stage("wxe", [
             *common, *xe_sched, "--checkpoint_path", f"{ckpt}/wxe",
             "--start_from", f"{ckpt}/xe",
             "--use_consensus_weights", "1",
             "--train_bcmrscores_pkl", train["consensus_pkl"],
             "--max_epochs", str(args.wxe_epochs),
             "--learning_rate", "1e-4",
-        ], return_result=True))
+        ])
 
     cst_common = [
         "--start_from", f"{ckpt}/wxe",
@@ -199,40 +440,33 @@ def main() -> int:
     ]
 
     if "cst" in stages:
-        print("=== stage: CST (greedy baseline, fused rewards) ===",
-              flush=True)
-        report("cst", train_cli.main([
+        run_train_stage("cst (greedy baseline, fused rewards)", [
             *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst",
             "--rl_baseline", "greedy",
-        ], return_result=True))
+        ])
 
     if "cst_scb_sample" in stages:
-        print("=== stage: CST (SCB-sample leave-one-out baseline) ===",
-              flush=True)
-        report("cst_scb_sample", train_cli.main([
+        run_train_stage("cst_scb_sample (leave-one-out baseline)", [
             *common, *cst_common,
             "--checkpoint_path", f"{ckpt}/cst_scb_sample",
             "--rl_baseline", "scb-sample",
-        ], return_result=True))
+        ])
 
     if "cst_scb" in stages:
-        print("=== stage: CST (SCB-gt baseline, fused rewards) ===",
-              flush=True)
-        report("cst_scb", train_cli.main([
+        run_train_stage("cst_scb (SCB-gt baseline, fused rewards)", [
             *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst_scb",
             "--rl_baseline", "scb-gt",
             "--train_bcmrscores_pkl", train["consensus_pkl"],
-        ], return_result=True))
+        ])
 
     if "eval" in stages:
-        import eval as eval_cli
-
         for stage in ("wxe", "cst", "cst_scb", "cst_scb_sample"):
             d = f"{ckpt}/{stage}"
             if not os.path.exists(os.path.join(d, "infos.json")):
                 continue
             print(f"=== beam-5 eval: {stage} ===", flush=True)
-            eval_cli.main([
+            run_stage(f"eval:{stage}", [
+                sys.executable, "eval.py",
                 "--checkpoint_path", d,
                 "--test_feat_h5", *json.loads(val["feat_h5"]),
                 "--test_label_h5", val["label_h5"],
@@ -242,7 +476,10 @@ def main() -> int:
                 "--max_length", "30",
                 "--result_file", os.path.join(args.out_dir,
                                               f"{stage}_beam5.json"),
-            ])
+            ], max_attempts=args.max_stage_attempts,
+               wedge_poll_s=args.wedge_poll,
+               max_wedge_wait_s=args.max_wedge_wait,
+               timeout_s=args.eval_timeout)
     return 0
 
 

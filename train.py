@@ -22,16 +22,24 @@ from cst_captioning_tpu.parallel.dp import distributed_init
 from cst_captioning_tpu.training.trainer import Trainer
 from cst_captioning_tpu.utils.platform import (configure_cli_logging,
                                                enable_compile_cache)
+from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
 
 
-def main(argv=None, return_result: bool = False):
-    """CLI entry; ``return_result=True`` returns the summary dict instead
-    of the exit code (for driver scripts like scripts/scale_chain.py)."""
+def main(argv=None) -> int:
+    """CLI entry.  Drivers that need the outcome read the stage's
+    ``infos.json`` (scripts/scale_chain.py) or the JSON summary line this
+    prints — both survive the subprocess boundary a wedge-recovery rerun
+    needs, unlike an in-process return value."""
     opt = parse_opts(argv)
     configure_cli_logging(opt.loglevel)
     enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
-    distributed_init(opt.coordinator_address,
-                     opt.num_processes or None, opt.process_id)
+    # distributed_init touches the backend before the Trainer's own
+    # watchdog exists; cover it with a short-lived one so a coordinator
+    # that never answers still produces exit 124, not a silent hang.
+    with ProgressWatchdog(getattr(opt, "wedge_timeout", 0.0) or 0.0,
+                          describe=lambda: "during distributed_init"):
+        distributed_init(opt.coordinator_address,
+                         opt.num_processes or None, opt.process_id)
     trainer = Trainer(opt)
     try:
         result = trainer.train()
@@ -45,7 +53,7 @@ def main(argv=None, return_result: bool = False):
         "checkpoint_path": opt.checkpoint_path,
     }
     print(json.dumps(summary))
-    return summary if return_result else 0
+    return 0
 
 
 if __name__ == "__main__":
