@@ -202,5 +202,7 @@ def eval_split(
     preds = decode_split(model, params, loader, vocab, max_len,
                          beam_size=beam_size, length_norm=length_norm,
                          mesh=mesh, beat=beat)
+    if beat is not None:
+        beat()  # decode done; host-side scoring gets a fresh full window
     scores = language_eval(preds, refs, scorers=scorers)
     return preds, scores

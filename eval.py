@@ -84,17 +84,31 @@ def main(argv=None) -> int:
     explicit = frozenset(
         a[2:].split("=", 1)[0] for a in raw if a.startswith("--")
     ) & {"max_length"}
-    with CaptionDataset(paths) as ds:
-        model, params, opt = load_model_for_eval(opt.checkpoint_path, ds, opt,
-                                                 cli_explicit=explicit)
-        loader = CaptionLoader(ds, batch_size=opt.eval_batch_size or opt.batch_size,
-                               seq_per_img=1, shuffle=False)
+    # Same wedge protection as the trainer (utils/watchdog.py): heartbeat
+    # after the checkpoint restore, after every decoded batch, and between
+    # decode and host scoring, so a dead transport exits 124 promptly
+    # instead of hanging the eval.  As with training, --wedge_timeout must
+    # exceed the longest single blocking call — the first beam compile
+    # cannot beat mid-compile.
+    from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
+
+    with ProgressWatchdog(
+        getattr(opt, "wedge_timeout", 0.0) or 0.0,
+        describe=lambda: f"eval of {opt.checkpoint_path}",
+    ) as watchdog, CaptionDataset(paths) as ds:
+        model, params, opt = load_model_for_eval(
+            opt.checkpoint_path, ds, opt, cli_explicit=explicit)
+        watchdog.beat()  # restore done
+        loader = CaptionLoader(
+            ds, batch_size=opt.eval_batch_size or opt.batch_size,
+            seq_per_img=1, shuffle=False)
         mesh = make_mesh(jax.devices())  # decode shards over every chip
         preds, scores = eval_split(
             model, params, loader, ds.vocab, opt.max_length,
             ds.references(),
             beam_size=opt.beam_size, length_norm=opt.length_norm,
             mesh=mesh,
+            beat=watchdog.beat,
         )
     log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
     if opt.result_file:

@@ -108,8 +108,9 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
     stage's on-disk progress — checkpoint steps, metrics length; without
     one, every failed attempt counts as no-progress.
 
-    ``timeout_s`` is a harness-side hard cap for commands that have no
-    in-process watchdog (eval); 0 means none.  The subprocess gets its own
+    ``timeout_s`` is a harness-side hard cap layered on top of the
+    command's own in-process watchdog (both train and eval stages arm
+    ``--wedge_timeout``); 0 means none.  The subprocess gets its own
     session so a timeout kill takes the whole process group."""
     probed_detail = {"printed": False}
 
@@ -138,9 +139,8 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                 f"stage {tag}: {no_progress} consecutive attempts made no "
                 "on-disk progress while the device stayed healthy — if "
                 "each died at exit 124 at the same point, a legitimate "
-                "phase (first compile/upload, a long eval) likely exceeds "
-                "its timeout (--wedge_timeout for train stages, "
-                "--eval_timeout for eval); raise it rather than retrying")
+                "blocking phase (first compile/upload) likely exceeds "
+                "--wedge_timeout; raise it rather than retrying")
         attempt += 1
         if attempt > 1:
             print(f"=== {tag}: attempt {attempt} (resume; {no_progress} "
@@ -335,8 +335,9 @@ def main() -> int:
                         "stage's checkpoints reset the count, so a long "
                         "run surviving many tunnel flaps is never capped")
     p.add_argument("--eval_timeout", type=float, default=3600.0,
-                   help="hard cap per eval invocation (eval has no "
-                        "in-process watchdog); 0 = none")
+                   help="harness-side hard cap per eval invocation, a "
+                        "second safety net over eval's own in-process "
+                        "--wedge_timeout watchdog; 0 = none")
     args = p.parse_args()
     # Stages run as subprocesses with cwd=REPO; a relative --out_dir must
     # mean the same directory in the harness and in every stage.
@@ -474,6 +475,7 @@ def main() -> int:
                 "--test_cocofmt_file", val["cocofmt_json"],
                 "--beam_size", "5", "--batch_size", str(args.batch_size),
                 "--max_length", "30",
+                "--wedge_timeout", str(args.wedge_timeout),
                 "--result_file", os.path.join(args.out_dir,
                                               f"{stage}_beam5.json"),
             ], max_attempts=args.max_stage_attempts,

@@ -124,6 +124,68 @@ def test_trainer_watchdog_kills_wedged_run(tmp_path):
     assert "wedged" in proc.stderr  # the CRITICAL last word
 
 
+# Driver for the eval-wiring test: real eval.py on a trained fixture
+# whose decode wedges — the armed --wedge_timeout must kill it at 124.
+WEDGED_EVAL = """\
+import sys, time, json
+sys.path.insert(0, %(repo)r)
+from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+import train as train_cli
+import eval as eval_cli
+
+root = sys.argv[1]
+spec = SyntheticSpec(num_videos=4, captions_per_video=2, max_len=8,
+                     feat_dims=(8,), feat_times=(2,))
+train = generate(root, "train", spec)
+common = [
+    "--train_feat_h5", *json.loads(train["feat_h5"]),
+    "--train_label_h5", train["label_h5"],
+    "--train_info_json", train["info_json"],
+    "--train_cocofmt_file", train["cocofmt_json"],
+    "--checkpoint_path", root + "/ck",
+    "--batch_size", "2", "--seq_per_img", "2", "--rnn_size", "16",
+    "--input_encoding_size", "16", "--att_size", "16", "--max_length", "8",
+    "--max_epochs", "1", "--log_every", "1",
+]
+train_cli.main(common)
+# Wedge the decode path: the compiled-decoder factory never returns, like
+# a dead transport under the beam compile.
+from cst_captioning_tpu.training import evaluation
+evaluation._compiled_decoder = lambda *a, **k: time.sleep(3600)
+eval_cli.main([
+    "--checkpoint_path", root + "/ck",
+    "--test_feat_h5", *json.loads(train["feat_h5"]),
+    "--test_label_h5", train["label_h5"],
+    "--test_info_json", train["info_json"],
+    "--test_cocofmt_file", train["cocofmt_json"],
+    "--beam_size", "2", "--batch_size", "2", "--max_length", "8",
+    "--wedge_timeout", "2",
+])
+print("UNREACHABLE")
+"""
+
+
+@pytest.mark.e2e
+def test_eval_watchdog_kills_wedged_eval(tmp_path):
+    script = tmp_path / "wedged_eval.py"
+    script.write_text(WEDGED_EVAL % {"repo": REPO})
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import CACHE_DIR
+
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    proc = subprocess.run(
+        [sys.executable, str(script), str(tmp_path / "d")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == WEDGE_EXIT_CODE, (
+        f"rc={proc.returncode}\nstdout:{proc.stdout[-2000:]}\n"
+        f"stderr:{proc.stderr[-2000:]}")
+    assert "UNREACHABLE" not in proc.stdout
+    assert "wedged" in proc.stderr
+
+
 # -- scale_chain harness recovery -----------------------------------------
 
 def _cpu_env():
