@@ -109,6 +109,7 @@ demo:
 # beam-5 eval, stage-resumable.  scripts/scale_chain.py --help for knobs.
 scale_chain:
 	$(PY) scripts/scale_chain.py --out_dir /tmp/cst_scale \
+	  --num_videos 6513 --num_val 497 --lr_decay_every 10 \
 	  --stages xe,wxe,cst,cst_scb_sample,eval
 
 clean:

@@ -14,6 +14,7 @@ schema); only the feature h5s are synthesized here.
 from __future__ import annotations
 
 import json
+import logging
 import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
@@ -24,6 +25,8 @@ import numpy as np
 from ..metrics import tokenize
 from .prepro import build_split
 from .vocab import Vocab, load_vocab
+
+log = logging.getLogger(__name__)
 
 _SUBJECTS = ["a man", "a woman", "a dog", "a cat", "a child"]
 _VERBS = ["is cooking", "is running", "is singing", "is playing", "is dancing"]
@@ -140,6 +143,37 @@ def _make_captions(rng: np.random.Generator, spec: SyntheticSpec,
     return all_caps
 
 
+def _warn_if_degenerate_exposure(captions) -> None:
+    """Warn when the generated corpus is statistically unlearnable.
+
+    Field lesson (round 4): at 640 videos x 8k-word pools the median
+    content word appeared in exactly ONE video, so most words were
+    video-private, val generalization was impossible, and XE collapsed
+    to function-word templates while train loss fell normally.  Real
+    MSR-VTT avoids this with ~6.5k train videos (plus a count-threshold
+    to UNK in prepro).  "MSR-VTT scale" must mean the VIDEO COUNT, not
+    just vocab/feature shapes.
+    """
+    videos_per_word: Dict[str, set] = {}
+    for i, caps in enumerate(captions):
+        for c in caps:
+            for w in c.split():
+                videos_per_word.setdefault(w, set()).add(i)
+    counts = sorted(len(v) for v in videos_per_word.values())
+    if not counts:
+        return
+    median = counts[len(counts) // 2]
+    if median <= 1:
+        singletons = sum(1 for c in counts if c == 1) / len(counts)
+        log.warning(
+            "synthetic corpus is statistically DEGENERATE: the median "
+            "content word appears in %d video(s) (%.0f%% in exactly one) "
+            "— val generalization is impossible for most words and XE "
+            "will collapse to function-word templates. Raise num_videos "
+            "toward the real dataset's count (MSR-VTT: 6513 train) or "
+            "shrink rich_vocab.", median, 100 * singletons)
+
+
 def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpec(),
              vocab: Vocab | None = None) -> Dict[str, str]:
     """Write one split's artifact set under ``root``; returns the path map.
@@ -156,6 +190,8 @@ def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpe
         [{"id": v, "captions": caps} for v, caps in zip(video_ids, captions)],
         root, split, max_len=spec.max_len, vocab=vocab,
     )
+    if split == "train" and spec.rich_vocab:
+        _warn_if_degenerate_exposure(captions)
     vocab = load_vocab(paths["vocab_json"])
 
     # Features: deterministic per-video signal derived from the first

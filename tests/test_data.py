@@ -289,6 +289,31 @@ class TestRichSyntheticGrammar:
         val = generate(str(tmp_path), "val", val_spec, vocab=vocab)
         return train, val, vocab
 
+    def test_degenerate_word_exposure_warns(self, tmp_path, caplog):
+        """A corpus whose median content word lives in one video is
+        unlearnable (round-4 field collapse at 640 videos x 8k pools);
+        the generator must say so loudly at generation time."""
+        import logging
+
+        spec = SyntheticSpec(num_videos=6, captions_per_video=6,
+                             max_len=30, feat_dims=(32,), feat_times=(2,),
+                             rich_vocab=4000)  # huge pools, few videos
+        with caplog.at_level(logging.WARNING,
+                             logger="cst_captioning_tpu.data.synthetic"):
+            generate(str(tmp_path / "degen"), "train", spec)
+        assert any("DEGENERATE" in r.message for r in caplog.records)
+
+    def test_healthy_word_exposure_is_silent(self, tmp_path, caplog):
+        import logging
+
+        spec = SyntheticSpec(num_videos=40, captions_per_video=6,
+                             max_len=30, feat_dims=(32,), feat_times=(2,),
+                             rich_vocab=60)  # small pools, many videos
+        with caplog.at_level(logging.WARNING,
+                             logger="cst_captioning_tpu.data.synthetic"):
+            generate(str(tmp_path / "healthy"), "train", spec)
+        assert not any("DEGENERATE" in r.message for r in caplog.records)
+
     def test_val_vocabulary_subset_of_train(self, tmp_path):
         """Val concepts must be train-realized words: otherwise val
         metrics measure vocabulary luck, not learning (round-4 review)."""
