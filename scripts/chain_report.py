@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Summarize a scale-chain run: per-stage val trajectories + beam-5 evals.
+
+Reads each stage's metrics.jsonl / infos.json under
+<out_dir>/checkpoints/<stage>/ and the <stage>_beam5.json result files,
+and prints a markdown report — the evidence table for PARITY.md.
+
+Usage: python scripts/chain_report.py --out_dir /tmp/cst_scale_r4b
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+STAGES = ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample")
+
+
+def stage_rows(stage_dir: str):
+    path = os.path.join(stage_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed run
+            if rec.get("scope") == "val":
+                rows.append(rec)
+    return rows
+
+
+def sparkline(vals, width: int = 24):
+    """Coarse text trajectory: first/min/max/last at a glance."""
+    if not vals:
+        return ""
+    if len(vals) > width:
+        idx = [round(i * (len(vals) - 1) / (width - 1)) for i in range(width)]
+        vals = [vals[i] for i in idx]
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return "▄" * len(vals)
+    blocks = "▁▂▃▄▅▆▇█"
+    return "".join(blocks[int((v - lo) / (hi - lo) * 7)] for v in vals)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--metric", default="CIDEr")
+    args = ap.parse_args()
+    ckpt = os.path.join(args.out_dir, "checkpoints")
+
+    print(f"## Scale-chain report — {args.out_dir}\n")
+    print("| stage | epochs | first | best (step) | last | trajectory |")
+    print("|---|---|---|---|---|---|")
+    for stage in STAGES:
+        d = os.path.join(ckpt, stage)
+        rows = [r for r in stage_rows(d) if args.metric in r]
+        vals = [r[args.metric] for r in rows]
+        if not vals:
+            continue
+        best_i = max(range(len(vals)), key=vals.__getitem__)
+        print(f"| {stage} | {len(vals)} | {vals[0]:.4f} "
+              f"| **{vals[best_i]:.4f}** ({rows[best_i]['step']}) "
+              f"| {vals[-1]:.4f} | `{sparkline(vals)}` |")
+
+    beam = []
+    for stage in STAGES:
+        p = os.path.join(args.out_dir, f"{stage}_beam5.json")
+        if os.path.exists(p):
+            try:
+                with open(p) as f:
+                    beam.append((stage, json.load(f)["scores"]))
+            except (ValueError, KeyError):
+                # torn file from a killed eval; report what we have
+                print(f"\n(skipping torn/partial {p})")
+    if beam:
+        keys = sorted({k for _, s in beam for k in s})
+        print("\n### Held-out beam-5 eval (best checkpoint per stage)\n")
+        print("| stage | " + " | ".join(keys) + " |")
+        print("|---" * (len(keys) + 1) + "|")
+        for stage, s in beam:
+            print(f"| {stage} | " +
+                  " | ".join(f"{s.get(k, float('nan')):.4f}" for k in keys) +
+                  " |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
