@@ -119,8 +119,20 @@ class CheckpointManager:
         # would turn the NEXT resume into a json.load crash — the recovery
         # mechanism bricking the run it exists to save.
         tmp = self._infos_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.infos, f, indent=2, default=str)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self.infos, f, indent=2, default=str)
+                # fsync before rename: a host crash can journal the rename
+                # without the data, leaving an EMPTY infos.json — worse
+                # than the stale one the rename replaced.
+                f.flush()
+                os.fsync(f.fileno())
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         os.replace(tmp, self._infos_path)
 
     def save_recovery(self, step: int, state) -> None:

@@ -57,6 +57,7 @@ class ProgressWatchdog:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ProgressWatchdog":
         if self.timeout_s > 0 and self._thread is None:
+            self._stop.clear()
             self.beat()
             self._thread = threading.Thread(
                 target=self._run, name="progress-watchdog", daemon=True)
@@ -86,7 +87,11 @@ class ProgressWatchdog:
             gap = time.monotonic() - self._last
             if gap > self.timeout_s:
                 self._on_timeout(gap)
-                return
+                # The default handler never returns (os._exit).  An
+                # injected handler that does return wants continued
+                # monitoring: rearm the heartbeat so the next timeout
+                # measures a fresh gap instead of refiring every poll.
+                self.beat()
 
     def _die(self, gap: float) -> None:  # pragma: no cover - exits process
         msg = ("no progress for %.0fs (timeout %.0fs) — device backend "

@@ -173,6 +173,13 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                 # checkpoint resume, so a retry repeats the identical
                 # run).  One retry distinguishes them; a second
                 # CONSECUTIVE healthy timeout means raise the cap.
+                if progressed:
+                    # Progress clears BOTH counters FIRST: a checkpointed
+                    # attempt that later times out is a new situation, not
+                    # "twice in a row" — it must not trip the abort below.
+                    no_progress, healthy_timeouts = 0, 0
+                else:
+                    no_progress += 1
                 healthy_timeouts += 1
                 if healthy_timeouts >= 2:
                     raise SystemExit(
@@ -180,10 +187,6 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                         "timeout twice in a row while the device probe "
                         "succeeds — not a wedge; raise the timeout (e.g. "
                         "--eval_timeout) instead of retrying")
-                if progressed:
-                    no_progress = 0
-                else:
-                    no_progress += 1
                 continue
             known_wedged = True
         elif rc != WEDGE_EXIT_CODE:
@@ -221,6 +224,34 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
             healthy_timeouts = 0
         else:
             no_progress += 1
+
+
+def stage_fingerprint(stage_dir):
+    """Snapshot of the stage's REAL progress markers: the recorded
+    last/best step from infos.json plus the set of on-disk checkpoint
+    step directories (best-score and recovery managers).  Deliberately
+    NOT every file's size — metrics.jsonl/TB appends from re-running
+    the same steps after a resume would otherwise count as 'progress'
+    and reset the no-progress cap on every attempt, letting a
+    deterministic wedge firing past the last checkpoint retry forever."""
+    def fp():
+        marks = []
+        try:
+            with open(os.path.join(stage_dir, "infos.json")) as f:
+                infos = json.load(f)
+            marks.append(("infos", infos.get("last_step"),
+                          infos.get("best_step")))
+        except (OSError, ValueError):
+            pass
+        for sub in (".", "recovery"):
+            d = os.path.join(stage_dir, sub)
+            try:
+                steps = sorted(e for e in os.listdir(d) if e.isdigit())
+            except OSError:
+                steps = []
+            marks.append((sub, tuple(steps)))
+        return tuple(marks)
+    return fp
 
 
 def generate_data(root: str, num_videos: int, num_val: int,
@@ -380,22 +411,6 @@ def main() -> int:
         "--learning_rate_decay_rate", "0.5",
     ]
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
-
-    def stage_fingerprint(stage_dir):
-        """Snapshot of the stage's on-disk state (paths + sizes): any
-        checkpoint, metrics, or infos write between attempts counts as
-        progress and resets the no-progress attempt cap."""
-        def fp():
-            entries = []
-            for base, _dirs, files in os.walk(stage_dir):
-                for f in files:
-                    p = os.path.join(base, f)
-                    try:
-                        entries.append((p, os.stat(p).st_size))
-                    except OSError:
-                        continue
-            return tuple(sorted(entries))
-        return fp
 
     def run_train_stage(tag, argv):
         print(f"=== stage: {tag} ===", flush=True)

@@ -68,6 +68,38 @@ class TestProgressWatchdog:
         time.sleep(0.5)
         assert not fired
 
+    def test_restart_after_stop_monitors_again(self):
+        """stop() then start() must rearm monitoring — the _stop Event is
+        cleared, so the restarted thread does not return immediately."""
+        fired = []
+        wd = ProgressWatchdog(0.2, on_timeout=lambda g: fired.append(g))
+        wd.start()
+        wd.stop()
+        wd.start()
+        try:
+            deadline = time.time() + 5.0
+            while not fired and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert fired, "restarted watchdog never fired"
+
+    def test_rearms_after_non_exiting_handler(self):
+        """An injected on_timeout that RETURNS (unlike the default
+        os._exit) keeps the monitor alive: the heartbeat is rearmed and a
+        second stall fires again instead of leaving the process
+        unmonitored."""
+        fired = []
+        wd = ProgressWatchdog(0.2, on_timeout=lambda g: fired.append(g))
+        wd.start()
+        try:
+            deadline = time.time() + 10.0
+            while len(fired) < 2 and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert len(fired) >= 2, f"watchdog fired {len(fired)}x, wanted >=2"
+
 
 # Driver for the trainer-wiring test: a real Trainer on a tiny fixture
 # whose validate() wedges forever — the armed --wedge_timeout must kill
@@ -288,6 +320,43 @@ def test_run_stage_timeout_kills_group_and_retries(tmp_path):
                  timeout_s=2.0, probe_timeout_s=20.0, env=_cpu_env())
     assert time.time() - t0 < 90
     assert marker.exists()
+
+
+def test_stage_fingerprint_ignores_log_appends(tmp_path):
+    """Only real progress markers count: infos.json step fields and the
+    set of checkpoint step dirs.  metrics.jsonl/TB appends from re-running
+    the same steps after a resume must NOT read as progress (they would
+    defeat the no-progress attempt cap on a deterministic wedge)."""
+    sc = _load_scale_chain()
+    stage = tmp_path / "xe"
+    stage.mkdir()
+    fp = sc.stage_fingerprint(str(stage))
+    base = fp()
+
+    # Log/TB churn alone: no change.
+    (stage / "metrics.jsonl").write_text('{"step": 1}\n')
+    assert fp() == base
+    (stage / "metrics.jsonl").write_text('{"step": 1}\n{"step": 1}\n')
+    assert fp() == base
+
+    # A new checkpoint step dir IS progress...
+    (stage / "100").mkdir()
+    after_ckpt = fp()
+    assert after_ckpt != base
+    # ...as is a recovery-manager save...
+    (stage / "recovery").mkdir()
+    (stage / "recovery" / "150").mkdir()
+    after_rec = fp()
+    assert after_rec != after_ckpt
+    # ...and an infos.json step advance.
+    (stage / "infos.json").write_text(
+        json.dumps({"last_step": 150, "best_step": 100}))
+    after_infos = fp()
+    assert after_infos != after_rec
+    # Rewriting infos.json with identical steps: no change.
+    (stage / "infos.json").write_text(
+        json.dumps({"best_step": 100, "last_step": 150}))
+    assert fp() == after_infos
 
 
 def test_run_stage_aborts_on_second_healthy_timeout(tmp_path):
