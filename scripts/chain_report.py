@@ -1,9 +1,22 @@
 #!/usr/bin/env python
-"""Summarize a scale-chain run: per-stage val trajectories + beam-5 evals.
+"""Summarize a scale-chain run: STATUS, per-stage val trajectories, beam evals.
 
-Reads each stage's metrics.jsonl / infos.json under
-<out_dir>/checkpoints/<stage>/ and the <stage>_beam5.json result files,
-and prints a markdown report — the evidence table for PARITY.md.
+Reads three evidence channels under --out_dir:
+
+- ``chain_events.jsonl`` — the harness's structured lifecycle log
+  (written by scripts/scale_chain.py): stage starts, attempts, wedges,
+  probe verdicts, heals, aborts.  This is what lets the report say WHY
+  there are no learning curves yet — "wedged since 14:34, 37 probes" is
+  a blocked chain; silence is a broken one.
+- ``checkpoints/<stage>/metrics.jsonl`` — per-stage val trajectories.
+- ``<stage>_beam5.json`` — held-out beam-eval scores.
+
+``--log FILE`` additionally parses a console log's ``=== ... ===``
+markers for chains started before the event log existed (no timestamps
+there — the file's mtime stands in for last activity).
+
+``--json FILE`` writes the whole report (status + curves + beam) as one
+JSON document — the committable machine-readable artifact.
 
 Usage: python scripts/chain_report.py --out_dir /tmp/cst_scale_r4b
 """
@@ -13,6 +26,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import time
 
 STAGES = ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample")
 
@@ -47,16 +62,217 @@ def sparkline(vals, width: int = 24):
     return "".join(blocks[int((v - lo) / (hi - lo) * 7)] for v in vals)
 
 
+def _ts(t: float) -> str:
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+
+
+def _ago(seconds: float) -> str:
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 5400:
+        return f"{seconds / 60:.0f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def load_events(out_dir: str):
+    path = os.path.join(out_dir, "chain_events.jsonl")
+    if not os.path.exists(path):
+        return []
+    events = []
+    with open(path) as f:
+        for line in f:
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail from a killed harness
+    # The chain can be re-invoked into the same out_dir (new stages after
+    # a heal); status describes the LATEST run only.
+    for i in range(len(events) - 1, -1, -1):
+        if events[i].get("event") == "chain_start":
+            return events[i:]
+    return events
+
+
+def chain_status(events, now: float | None = None) -> dict:
+    """Fold the event stream into 'where is the chain and since when'.
+
+    Returns {state, detail, since, stage, stages: {tag: counters}} with
+    state one of: no-events, running, wedged, healing, complete, aborted.
+    """
+    if not events:
+        return {"state": "no-events",
+                "detail": "no chain_events.jsonl — chain predates the "
+                          "event log or never started; try --log"}
+    now = now or time.time()
+    per_stage: dict[str, dict] = {}
+    stage = None
+    state, since, detail = "running", events[-1]["ts"], ""
+    for ev in events:
+        kind, tag = ev.get("event"), ev.get("tag")
+        if kind == "stage_start":
+            stage = tag
+            per_stage.setdefault(tag, {
+                "attempts": 0, "wedges": 0, "probes": 0,
+                "probes_since_wedge": 0, "started": ev["ts"], "done": None,
+                "abort": None, "best_score": None})
+        s = per_stage.get(tag) if tag else None
+        if kind == "attempt_start" and s:
+            s["attempts"] = max(s["attempts"], ev.get("attempt", 0))
+            state, since, detail = "running", ev["ts"], \
+                f"attempt {ev.get('attempt')}"
+        elif kind == "wedge" and s:
+            s["wedges"] += 1
+            s["probes_since_wedge"] = 0
+            state, since = "wedged", ev["ts"]
+            detail = f"stage exited rc={ev.get('rc')}"
+        elif kind == "probe" and s:
+            s["probes"] += 1
+            if state == "wedged":
+                s["probes_since_wedge"] += 1
+        elif kind == "healed" and s:
+            state, since = "healing", ev["ts"]
+            detail = f"device back after {_ago(ev.get('waited_s', 0))}"
+        elif kind == "stage_done" and s:
+            s["done"] = ev["ts"]
+            state, since, detail = "running", ev["ts"], f"{tag} done"
+        elif kind == "stage_best" and s:
+            s["best_score"] = ev.get("best_score")
+        elif kind == "stage_abort" and s:
+            s["abort"] = ev.get("reason")
+            state, since = "aborted", ev["ts"]
+            detail = f"{tag}: {ev.get('reason')}"
+        elif kind == "chain_done":
+            state, since, detail = "complete", ev["ts"], ""
+            stage = None
+    return {"state": state, "detail": detail, "since": since,
+            "age_s": round(now - since, 1), "stage": stage,
+            "last_event": events[-1].get("event"),
+            "last_event_age_s": round(now - events[-1]["ts"], 1),
+            "stages": per_stage}
+
+
+# Console-marker fallback for chains older than the event log.
+_MARKERS = (
+    (re.compile(r"^=== stage: (\S+)"), "stage"),
+    (re.compile(r"^=== (\S+?): attempt (\d+)"), "attempt"),
+    (re.compile(r"^=== (\S+?): wedge \(rc=(-?\d+)\)"), "wedge"),
+    (re.compile(r"^=== (\S+?): device probe detail: (.*?) ==="), "detail"),
+    (re.compile(r"^=== (\S+?) done"), "done"),
+    (re.compile(r"^WATCHDOG:"), "watchdog"),
+)
+
+
+def log_status(log_path: str, now: float | None = None) -> dict:
+    """Best-effort status from a console log's marker lines.  The print
+    markers carry no timestamps; the file's mtime is the last-activity
+    proxy (heal-poll probes do not write, so a wedged chain's log can be
+    legitimately old)."""
+    counts: dict[str, int] = {}
+    last_marker, stage, wedged = None, None, False
+    details = []
+    try:
+        with open(log_path, errors="replace") as f:
+            for line in f:
+                for rx, kind in _MARKERS:
+                    m = rx.match(line.strip())
+                    if not m:
+                        continue
+                    counts[kind] = counts.get(kind, 0) + 1
+                    last_marker = line.strip()
+                    if kind == "stage":
+                        stage, wedged = m.group(1), False
+                    elif kind == "wedge":
+                        wedged = True
+                    elif kind == "attempt":
+                        # A resume attempt means the device healed and the
+                        # stage is training again — no longer wedged.
+                        wedged = False
+                    elif kind == "detail":
+                        details.append(m.group(2))
+                    elif kind == "done":
+                        wedged = False
+                    break
+    except OSError as e:
+        return {"state": "no-log", "detail": str(e)}
+    now = now or time.time()
+    try:
+        mtime = os.stat(log_path).st_mtime
+    except OSError:
+        mtime = now
+    return {"state": "wedged" if wedged else "running",
+            "stage": stage, "counts": counts, "last_marker": last_marker,
+            "last_write_age_s": round(now - mtime, 1),
+            "probe_details": details[-3:]}
+
+
+def print_status(status: dict) -> None:
+    print("### Chain status\n")
+    state = status.get("state")
+    if state == "no-events":
+        print(f"- **status unknown** — {status['detail']}")
+        return
+    if state == "no-log":
+        print(f"- **no log** — {status['detail']}")
+        return
+    if "since" in status:  # event-log status
+        line = f"- **{state}**"
+        if status.get("stage"):
+            line += f" in stage `{status['stage']}`"
+        line += f" since {_ts(status['since'])} ({_ago(status['age_s'])} ago)"
+        if status.get("detail"):
+            line += f" — {status['detail']}"
+        print(line)
+        print(f"- last event: `{status['last_event']}` "
+              f"{_ago(status['last_event_age_s'])} ago")
+        for tag, s in status.get("stages", {}).items():
+            bits = [f"attempts {s['attempts']}", f"wedges {s['wedges']}",
+                    f"probes {s['probes']}"]
+            if s["wedges"] and s["probes_since_wedge"]:
+                bits.append(f"{s['probes_since_wedge']} since last wedge")
+            if s["abort"]:
+                bits.append(f"ABORTED: {s['abort']}")
+            if s["done"]:
+                bits.append("done")
+            if s["best_score"] is not None:
+                bits.append(f"best {s['best_score']:.4f}")
+            print(f"  - `{tag}`: " + ", ".join(bits))
+    else:  # console-log status
+        line = f"- **{state}** (from console markers)"
+        if status.get("stage"):
+            line += f" in stage `{status['stage']}`"
+        print(line)
+        print(f"- marker counts: {status.get('counts', {})}")
+        if status.get("last_marker"):
+            print(f"- last marker: `{status['last_marker']}`")
+        print(f"- log last written {_ago(status['last_write_age_s'])} ago "
+              "(heal-poll probes do not write; old is normal while wedged)")
+        for d in status.get("probe_details", []):
+            print(f"  - probe detail: {d}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out_dir", required=True)
     ap.add_argument("--metric", default="CIDEr")
+    ap.add_argument("--log", default=None,
+                    help="console log to parse when the chain predates "
+                         "chain_events.jsonl")
+    ap.add_argument("--json", default=None,
+                    help="also write the full report as JSON here")
     args = ap.parse_args()
     ckpt = os.path.join(args.out_dir, "checkpoints")
+    report: dict = {"out_dir": args.out_dir, "metric": args.metric}
 
     print(f"## Scale-chain report — {args.out_dir}\n")
-    print("| stage | epochs | first | best (step) | last | trajectory |")
-    print("|---|---|---|---|---|---|")
+    events = load_events(args.out_dir)
+    status = chain_status(events)
+    if status["state"] == "no-events" and args.log:
+        status = log_status(args.log)
+    print_status(status)
+    report["status"] = status
+
+    report["curves"] = {}
+    table = []
     for stage in STAGES:
         d = os.path.join(ckpt, stage)
         rows = [r for r in stage_rows(d) if args.metric in r]
@@ -64,9 +280,18 @@ def main() -> int:
         if not vals:
             continue
         best_i = max(range(len(vals)), key=vals.__getitem__)
-        print(f"| {stage} | {len(vals)} | {vals[0]:.4f} "
-              f"| **{vals[best_i]:.4f}** ({rows[best_i]['step']}) "
-              f"| {vals[-1]:.4f} | `{sparkline(vals)}` |")
+        table.append(f"| {stage} | {len(vals)} | {vals[0]:.4f} "
+                     f"| **{vals[best_i]:.4f}** ({rows[best_i]['step']}) "
+                     f"| {vals[-1]:.4f} | `{sparkline(vals)}` |")
+        report["curves"][stage] = [
+            {"step": r["step"], args.metric: r[args.metric]} for r in rows]
+    if table:
+        print("\n| stage | epochs | first | best (step) | last | trajectory |")
+        print("|---|---|---|---|---|---|")
+        for row in table:
+            print(row)
+    else:
+        print("\n(no val curves yet — see status above for why)")
 
     beam = []
     for stage in STAGES:
@@ -87,6 +312,12 @@ def main() -> int:
             print(f"| {stage} | " +
                   " | ".join(f"{s.get(k, float('nan')):.4f}" for k in keys) +
                   " |")
+    report["beam"] = {stage: s for stage, s in beam}
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"\n(report JSON -> {args.json})")
     return 0
 
 

@@ -47,6 +47,28 @@ from cst_captioning_tpu.utils.platform import run_in_group  # noqa: E402
 from cst_captioning_tpu.utils.watchdog import WEDGE_EXIT_CODE  # noqa: E402
 
 
+class EventLog:
+    """Append-only JSONL record of the chain's lifecycle — the machine-
+    readable twin of the ``=== ... ===`` console markers, so
+    chain_report.py can say WHY a chain has produced no curves yet
+    (wedged since when, probes so far, attempts per stage) without
+    anyone spelunking console logs.  Best-effort by design: a full disk
+    must not kill the harness whose job is riding out failures."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+
+    def emit(self, event: str, **fields) -> None:
+        if not self.path:
+            return
+        rec = {"ts": time.time(), "event": event, **fields}
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+
+
 def probe_device(timeout_s: float = 120.0,
                  env: dict | None = None) -> tuple[str, str]:
     """Can a FRESH process initialize the default jax backend right now?
@@ -96,7 +118,8 @@ def probe_device(timeout_s: float = 120.0,
 def run_stage(tag: str, cmd: list, *, max_attempts: int,
               wedge_poll_s: float, max_wedge_wait_s: float,
               timeout_s: float = 0.0, probe_timeout_s: float = 120.0,
-              env: dict | None = None, fingerprint=None) -> None:
+              env: dict | None = None, fingerprint=None,
+              events: EventLog | None = None) -> None:
     """Run ``cmd`` to completion, resuming across device wedges.
 
     ``max_attempts`` bounds CONSECUTIVE attempts without progress, not
@@ -113,19 +136,30 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
     ``--wedge_timeout``); 0 means none.  The subprocess gets its own
     session so a timeout kill takes the whole process group."""
     probed_detail = {"printed": False}
+    events = events or EventLog(None)
+
+    def abort(reason: str, msg: str) -> SystemExit:
+        events.emit("stage_abort", tag=tag, reason=reason)
+        return SystemExit(msg)
 
     def probe() -> str:
         verdict, detail = probe_device(probe_timeout_s, env)
+        events.emit("probe", tag=tag, verdict=verdict)
         if verdict == "broken":
-            raise SystemExit(
+            raise abort(
+                "broken_env",
                 f"stage {tag}: the stage environment cannot even import "
                 f"jax — not a wedge, aborting immediately:\n{detail}")
         if verdict == "wedged" and detail and not probed_detail["printed"]:
             # Surface the first probe's actual error once: a deterministic
             # fast failure (expired credentials, refused endpoint) would
             # otherwise heal-poll for hours with its cause never shown.
+            # Collapsed to ONE line so chain_report's marker parser (and
+            # any grep) sees the whole detail.
             probed_detail["printed"] = True
-            print(f"=== {tag}: device probe detail: {detail} ===",
+            one_line = " | ".join(
+                s for s in (x.strip() for x in detail.splitlines()) if s)
+            print(f"=== {tag}: device probe detail: {one_line} ===",
                   flush=True)
         return verdict
 
@@ -135,7 +169,8 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
     attempt = 0
     while True:
         if no_progress >= max_attempts:
-            raise SystemExit(
+            raise abort(
+                "no_progress_cap",
                 f"stage {tag}: {no_progress} consecutive attempts made no "
                 "on-disk progress while the device stayed healthy — if "
                 "each died at exit 124 at the same point, a legitimate "
@@ -146,6 +181,8 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
             print(f"=== {tag}: attempt {attempt} (resume; {no_progress} "
                   f"healthy attempts since progress, cap {max_attempts}) "
                   "===", flush=True)
+        events.emit("attempt_start", tag=tag, attempt=attempt,
+                    no_progress=no_progress)
         # run_in_group owns the kill semantics: own session, group-SIGKILL
         # on timeout AND on any unwind (Ctrl-C / SIGTERM-as-SystemExit), so
         # an interrupted harness never leaves a stage holding the device.
@@ -154,11 +191,14 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                           timeout=timeout_s or None, timeout_info=info)
         timed_out = info["timed_out"]
         if rc == 0:
+            events.emit("stage_done", tag=tag, attempts=attempt)
             return
         progressed = False
         if fingerprint:
             fp = fingerprint()
             progressed, last_fp = fp != last_fp, fp
+        events.emit("attempt_exit", tag=tag, attempt=attempt, rc=rc,
+                    timed_out=timed_out, progressed=progressed)
         # One probe decides this attempt's classification; the heal loop
         # below reuses that verdict for its first wait instead of
         # immediately spawning a second backend-init probe at a device we
@@ -182,7 +222,8 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                     no_progress += 1
                 healthy_timeouts += 1
                 if healthy_timeouts >= 2:
-                    raise SystemExit(
+                    raise abort(
+                        "healthy_timeout",
                         f"stage {tag} exceeded its {timeout_s:.0f}s harness "
                         "timeout twice in a row while the device probe "
                         "succeeds — not a wedge; raise the timeout (e.g. "
@@ -191,14 +232,17 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
             known_wedged = True
         elif rc != WEDGE_EXIT_CODE:
             if probe() == "ok":
-                raise SystemExit(
+                raise abort(
+                    "real_failure",
                     f"stage {tag} failed with rc={rc} while the device "
                     "probe succeeds — a real failure, not a wedge; "
                     "aborting")
             known_wedged = True
         print(f"=== {tag}: wedge (rc={rc}); polling for the device "
               f"every {wedge_poll_s:.0f}s ===", flush=True)
-        deadline = time.time() + max_wedge_wait_s
+        events.emit("wedge", tag=tag, rc=rc, attempt=attempt)
+        wedge_t0 = time.time()
+        deadline = wedge_t0 + max_wedge_wait_s
         healed = False
         observed_wedged = known_wedged
         if known_wedged:
@@ -210,9 +254,12 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
             observed_wedged = True
             time.sleep(wedge_poll_s)
         if not healed:
-            raise SystemExit(
+            raise abort(
+                "heal_wait_exhausted",
                 f"stage {tag}: device did not heal within "
                 f"{max_wedge_wait_s / 3600:.1f}h; giving up")
+        events.emit("healed", tag=tag,
+                    waited_s=round(time.time() - wedge_t0, 1))
         # Attempt accounting AFTER the facts are in: progress resets the
         # cap; an attempt that died while the device was observably down
         # proves nothing about the stage and does not count; only
@@ -381,11 +428,16 @@ def main() -> int:
 
     root = os.path.join(args.out_dir, "data")
     ckpt = os.path.join(args.out_dir, "checkpoints")
+    os.makedirs(args.out_dir, exist_ok=True)
+    events = EventLog(os.path.join(args.out_dir, "chain_events.jsonl"))
+    events.emit("chain_start", argv=sys.argv[1:], pid=os.getpid(),
+                stages=args.stages, num_videos=args.num_videos)
     paths = generate_data(root, args.num_videos, args.num_val,
                           feat_dims=args.feat_dims,
                           feat_times=args.feat_times,
                           rich_vocab=args.rich_vocab, guard_dir=ckpt)
     train, val = paths["train"], paths["val"]
+    events.emit("dataset_ready", root=root)
 
     common = [
         "--train_feat_h5", *json.loads(train["feat_h5"]),
@@ -412,19 +464,32 @@ def main() -> int:
     ]
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
 
-    def run_train_stage(tag, argv):
+    def run_train_stage(tag, argv, label: str = ""):
+        # Tags are SHORT ids (the checkpoint-dir name): they key the event
+        # log, match chain_report's marker regexes, and join against the
+        # curves/beam sections of the JSON report.  The human description
+        # goes on its own line.
         print(f"=== stage: {tag} ===", flush=True)
+        if label:
+            print(f"    ({label})", flush=True)
         stage_dir = argv[argv.index("--checkpoint_path") + 1]
+        events.emit("stage_start", tag=tag, stage_dir=stage_dir,
+                    label=label)
         run_stage(tag, [sys.executable, "train.py", *argv],
                   max_attempts=args.max_stage_attempts,
                   wedge_poll_s=args.wedge_poll,
                   max_wedge_wait_s=args.max_wedge_wait,
-                  fingerprint=stage_fingerprint(stage_dir))
+                  fingerprint=stage_fingerprint(stage_dir),
+                  events=events)
         try:
             with open(os.path.join(stage_dir, "infos.json")) as f:
                 infos = json.load(f)
             print(f"=== {tag} done: best {infos.get('best_score')} @ step "
                   f"{infos.get('best_step')} ===", flush=True)
+            events.emit("stage_best", tag=tag,
+                        best_score=infos.get("best_score"),
+                        best_step=infos.get("best_step"),
+                        last_step=infos.get("last_step"))
         except (OSError, ValueError):  # report is best-effort only
             print(f"=== {tag} done ===", flush=True)
 
@@ -456,31 +521,32 @@ def main() -> int:
     ]
 
     if "cst" in stages:
-        run_train_stage("cst (greedy baseline, fused rewards)", [
+        run_train_stage("cst", [
             *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst",
             "--rl_baseline", "greedy",
-        ])
+        ], label="greedy baseline, fused rewards")
 
     if "cst_scb_sample" in stages:
-        run_train_stage("cst_scb_sample (leave-one-out baseline)", [
+        run_train_stage("cst_scb_sample", [
             *common, *cst_common,
             "--checkpoint_path", f"{ckpt}/cst_scb_sample",
             "--rl_baseline", "scb-sample",
-        ])
+        ], label="leave-one-out baseline")
 
     if "cst_scb" in stages:
-        run_train_stage("cst_scb (SCB-gt baseline, fused rewards)", [
+        run_train_stage("cst_scb", [
             *common, *cst_common, "--checkpoint_path", f"{ckpt}/cst_scb",
             "--rl_baseline", "scb-gt",
             "--train_bcmrscores_pkl", train["consensus_pkl"],
-        ])
+        ], label="SCB-gt baseline, fused rewards")
 
     if "eval" in stages:
-        for stage in ("wxe", "cst", "cst_scb", "cst_scb_sample"):
+        for stage in ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample"):
             d = f"{ckpt}/{stage}"
             if not os.path.exists(os.path.join(d, "infos.json")):
                 continue
             print(f"=== beam-5 eval: {stage} ===", flush=True)
+            events.emit("stage_start", tag=f"eval:{stage}", stage_dir=d)
             run_stage(f"eval:{stage}", [
                 sys.executable, "eval.py",
                 "--checkpoint_path", d,
@@ -496,7 +562,8 @@ def main() -> int:
             ], max_attempts=args.max_stage_attempts,
                wedge_poll_s=args.wedge_poll,
                max_wedge_wait_s=args.max_wedge_wait,
-               timeout_s=args.eval_timeout)
+               timeout_s=args.eval_timeout, events=events)
+    events.emit("chain_done", stages=args.stages)
     return 0
 
 
