@@ -46,6 +46,81 @@ import numpy as np
 
 BASELINE_CAPTIONS_PER_SEC = 5000.0
 
+#: bf16 peak matmul TFLOP/s per chip by device_kind substring (first match
+#: wins; jax device_kind strings look like "TPU v5 lite").  Public numbers
+#: from the TPU generations' spec sheets; used only to turn achieved
+#: TFLOP/s into an MFU percentage.
+PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+)
+
+
+def peak_tflops(device_kind: str) -> float | None:
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def analytic_step_flops(args) -> dict:
+    """Analytic matmul FLOPs of one optimizer step, from the config alone.
+
+    Counts the MXU work the architecture performs (encoder projections,
+    memory projection, per-step attention, LSTM gates, vocab head) at
+    2 FLOPs/MAC, with backward ≈ 2x forward — the standard "model FLOPs"
+    convention, so the derived MFU excludes remat recompute and the
+    device CIDEr-D's integer hashing (both make real utilization slightly
+    higher than reported).  Shapes mirror build(): ResNet-152 (28, 2048) +
+    C3D (1, 4096) features, embed = attn = hidden.
+
+    CST counts the shipped fused step: sampled + greedy rollouts (forward
+    only, one shared encode) plus the REINFORCE gradient step (fwd+bwd)
+    over the sampled captions.
+    """
+    B, S, L = args.batch_size, args.seq_per_img, args.seq_len
+    N = B * S
+    H = A = args.hidden
+    V = args.vocab
+    feat = [(28, 2048), (1, 4096)]
+    T = sum(t for t, _ in feat)
+    enc = B * sum(t * d * H for t, d in feat)   # per-modality Dense
+    enc += B * (len(feat) * H) * H              # fuse Dense
+    enc += B * T * H * A                        # memory_proj (attention)
+    enc += B * H * 2 * H                        # state_init
+    # One decoder step for one caption: attention query proj + additive
+    # scores + context, LSTM gates on concat(embed, context) -> (3H x 4H),
+    # and the hoisted vocab head.
+    per_step = H * A + T * A + T * H + 3 * H * 4 * H + H * V
+    dec = N * L * per_step
+    fwd = enc + dec
+    xe = 3 * fwd * 2.0                          # fwd + 2x bwd, 2 FLOPs/MAC
+    # The greedy-baseline rollout decodes ONE row per image (B rows, not
+    # B*S — steps.py make_rollout_fused returns greedy (B, L)).
+    greedy_dec = B * L * per_step
+    cst = (enc + dec + greedy_dec) * 2.0 + xe
+    return {"xe": xe, "cst": cst}
+
+
+def mfu_fields(flops_per_step: float, captions_per_sec: float | None,
+               ncaps: int, device_kind: str | None) -> dict:
+    """captions/s -> {model_tflops_per_step, achieved_tflops, mfu_pct}.
+
+    mfu_pct is None off-TPU (no meaningful peak for the host CPU) and on
+    unrecognized device kinds."""
+    if not captions_per_sec:
+        return {}
+    achieved = flops_per_step * captions_per_sec / ncaps / 1e12
+    peak = peak_tflops(device_kind or "")
+    sig = lambda x: float(f"{x:.4g}")  # keep tiny-shape runs nonzero
+    return {
+        "model_tflops_per_step": sig(flops_per_step / 1e12),
+        "achieved_tflops": sig(achieved),
+        "mfu_pct": None if peak is None else sig(100.0 * achieved / peak),
+    }
+
 
 def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
           hidden: int, use_bfloat16: bool, scan_unroll: int | None = None):
@@ -319,6 +394,12 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_TPU_CACHE.json")
 
 
+def _git_sha() -> str:
+    from cst_captioning_tpu.utils.platform import git_head_sha
+
+    return git_head_sha(os.path.dirname(os.path.abspath(__file__)))
+
+
 def read_cache_entry(metric: str):
     """Last cached device measurement for ``metric``, or None (missing
     file, bad JSON, unknown metric) — shared by _emit's CPU-fallback
@@ -383,6 +464,10 @@ def _emit(result: dict, args) -> None:
                 cache = {"entries": {}}
             cache["entries"][metric] = {
                 "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                # The SHA pins which code produced the cached number, so a
+                # reader can diff the measured tree against HEAD instead
+                # of taking the repo's word for it.
+                "git_sha": _git_sha(),
                 # steps rides along informationally (averaging length of
                 # the cached measurement) without joining the identity.
                 "steps": args.steps,
@@ -421,6 +506,9 @@ def run_measurement(args) -> None:
         print(f"bench: CPU fallback trims --steps {args.steps} -> 5",
               file=sys.stderr)
         args.steps = 5
+    device_kind = getattr(jax.devices()[0], "device_kind", "")
+    ncaps = args.batch_size * args.seq_per_img
+    flops = analytic_step_flops(args)
     common = {
         "unit": "captions/s/chip",
         "platform": platform,
@@ -433,6 +521,7 @@ def run_measurement(args) -> None:
             "value": round(xe, 1),
             "vs_baseline": round(xe / BASELINE_CAPTIONS_PER_SEC, 3),
             **common,
+            **mfu_fields(flops["xe"], xe, ncaps, device_kind),
         }, args)
         return
     if args.stage == "cst":
@@ -443,6 +532,7 @@ def run_measurement(args) -> None:
             "vs_baseline": round(cst["value"] / BASELINE_CAPTIONS_PER_SEC, 3),
             **common,
             **{k: v for k, v in cst.items() if k != "value"},
+            **mfu_fields(flops["cst"], cst["value"], ncaps, device_kind),
         }, args)
         return
     # default: BOTH stages, headline = the worse of the two, so the driver
@@ -450,6 +540,8 @@ def run_measurement(args) -> None:
     xe = bench_xe(args)
     cst = bench_cst(args)
     worst = min(xe, cst["value"])
+    xe_mfu = mfu_fields(flops["xe"], xe, ncaps, device_kind)
+    cst_mfu = mfu_fields(flops["cst"], cst["value"], ncaps, device_kind)
     _emit({
         "metric": HEADLINE_METRIC["both"],
         "value": round(worst, 1),
@@ -464,6 +556,8 @@ def run_measurement(args) -> None:
         "cst_fused_captions_per_sec": cst["fused_captions_per_sec"],
         "cst_overlap_depth": cst["overlap_depth"],
         "cst_scorer": cst["scorer"],
+        **{f"xe_{k}": v for k, v in xe_mfu.items()},
+        **{f"cst_{k}": v for k, v in cst_mfu.items()},
     }, args)
 
 

@@ -119,6 +119,26 @@ def run_in_group(cmd: list, *, env: dict | None = None,
             kill_group()
 
 
+def git_head_sha(repo_dir: str | None = None) -> str:
+    """HEAD commit of ``repo_dir`` (default: this package's repo), or
+    ``"unknown"`` — evidence artifacts (BENCH_TPU_CACHE entries,
+    collect_evidence manifests) stamp results with the code that produced
+    them, and both stampers must share ONE fallback semantics."""
+    import subprocess
+
+    if repo_dir is None:
+        repo_dir = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_dir,
+            capture_output=True, text=True, timeout=10)
+        sha = proc.stdout.strip()
+        return sha if proc.returncode == 0 and sha else "unknown"
+    except Exception:
+        return "unknown"
+
+
 def enable_compile_cache(cache_dir: str) -> bool:
     """Turn on JAX's persistent compilation cache at ``cache_dir``.
 

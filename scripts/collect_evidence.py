@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Copy a scale-chain run's durable evidence into the repo's artifacts/.
+
+Learning claims in PARITY.md / round notes must resolve to committed,
+machine-readable files — not /tmp paths that evaporate between rounds
+(VERDICT r4, missing #2).  This collects exactly the small, textual
+pieces that back a learning-curve table:
+
+- per-stage ``metrics.jsonl`` + ``infos.json`` (val trajectories, best)
+- ``<stage>_beam5.json`` held-out beam evals
+- ``chain_events.jsonl`` (the harness lifecycle: attempts/wedges/heals)
+- ``SCALE_SPEC.json`` (the dataset spec the curves were trained on)
+- a freshly generated ``report.json`` / ``report.md`` (chain_report)
+
+and writes a ``MANIFEST.json`` recording the source dir, the git SHA the
+evidence was collected under, and the command that regenerates the run.
+
+Usage:
+  python scripts/collect_evidence.py --out_dir /tmp/evidence_probe64 \\
+      --name probe64 [--regen "python scripts/scale_chain.py ..."]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STAGES = ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample")
+
+
+sys.path.insert(0, REPO)
+from cst_captioning_tpu.utils.platform import git_head_sha  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--name", required=True,
+                    help="artifacts/<name>/ destination")
+    ap.add_argument("--regen", default=None,
+                    help="command that regenerates the run (recorded in "
+                         "MANIFEST.json); defaults to the chain_start "
+                         "argv from chain_events.jsonl if present")
+    ap.add_argument("--dest", default=os.path.join(REPO, "artifacts"),
+                    help="destination root (default: repo artifacts/)")
+    args = ap.parse_args()
+    src = os.path.abspath(args.out_dir)
+    dst = os.path.join(args.dest, args.name)
+    os.makedirs(dst, exist_ok=True)
+
+    copied = []
+
+    def take(rel_src: str, rel_dst: str | None = None) -> None:
+        s = os.path.join(src, rel_src)
+        if not os.path.exists(s):
+            return
+        d = os.path.join(dst, rel_dst or rel_src)
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        shutil.copyfile(s, d)
+        copied.append(rel_dst or rel_src)
+
+    take("chain_events.jsonl")
+    take("data/SCALE_SPEC.json", "SCALE_SPEC.json")
+    for stage in STAGES:
+        take(os.path.join("checkpoints", stage, "metrics.jsonl"),
+             os.path.join(stage, "metrics.jsonl"))
+        take(os.path.join("checkpoints", stage, "infos.json"),
+             os.path.join(stage, "infos.json"))
+        take(f"{stage}_beam5.json")
+
+    # Regenerate the report against the live out_dir so report + copies
+    # agree, then keep both renderings.
+    report_json = os.path.join(dst, "report.json")
+    with open(os.path.join(dst, "report.md"), "w") as f:
+        rc = subprocess.run(
+            [sys.executable, "scripts/chain_report.py", "--out_dir", src,
+             "--json", report_json],
+            cwd=REPO, stdout=f, stderr=subprocess.STDOUT, timeout=300,
+        ).returncode
+    # The manifest lists what EXISTS, not what was attempted: a failed
+    # chain_report must not leave the bundle claiming a report it lacks.
+    copied += [r for r in ("report.md", "report.json")
+               if os.path.exists(os.path.join(dst, r))]
+
+    regen = args.regen
+    if not regen:
+        try:
+            with open(os.path.join(src, "chain_events.jsonl")) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("event") == "chain_start":
+                        regen = ("python scripts/scale_chain.py "
+                                 + shlex.join(rec.get("argv", [])))
+        except (OSError, ValueError):
+            pass
+
+    manifest = {
+        "source_dir": src,
+        "collected_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "git_sha": git_head_sha(REPO),
+        "regen_command": regen,
+        "report_rc": rc,
+        "files": sorted(copied),
+    }
+    with open(os.path.join(dst, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(f"collected {len(copied)} files -> {dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,6 +76,45 @@ def test_default_emits_both_stages():
     assert out["cst_serial_captions_per_sec"] > 0
 
 
+def test_mfu_fields_in_artifact():
+    """The artifact self-reports utilization: analytic model FLOPs per
+    step, achieved TFLOP/s from the measured captions/s, and mfu_pct
+    (None on the host CPU, where no TPU peak applies)."""
+    out = run_bench()
+    for stage in ("xe", "cst"):
+        assert out[f"{stage}_model_tflops_per_step"] > 0
+        assert out[f"{stage}_achieved_tflops"] > 0
+        assert out[f"{stage}_mfu_pct"] is None  # platform=cpu
+
+
+def test_analytic_flops_defaults_magnitude():
+    """At the default MSR-VTT bench shapes the analytic XE step must land
+    where independent arithmetic puts it (~0.9 TFLOP: 640 captions x 30
+    steps x (12H^2 gates + H*V head) x 6) — a regression here means the
+    FLOPs model drifted from the architecture."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    import argparse
+
+    ns = argparse.Namespace(batch_size=32, seq_per_img=20, seq_len=30,
+                            vocab=8000, hidden=512)
+    flops = bench.analytic_step_flops(ns)
+    assert 0.7e12 < flops["xe"] < 1.1e12, flops
+    assert flops["cst"] > flops["xe"]  # rollouts + grad > grad alone
+
+    # mfu_fields: 640 captions/step at 30k caps/s -> ~47 steps/s.
+    f = bench.mfu_fields(flops["xe"], 30000.0, 640, "TPU v5 lite")
+    assert f["achieved_tflops"] == pytest.approx(
+        flops["xe"] * 30000.0 / 640 / 1e12, rel=1e-3)
+    assert f["mfu_pct"] == pytest.approx(
+        100 * f["achieved_tflops"] / 197.0, rel=1e-3)
+    assert bench.mfu_fields(flops["xe"], 100.0, 640, "weird")["mfu_pct"] is None
+    assert bench.mfu_fields(flops["xe"], None, 640, "TPU v4") == {}
+
+
 def test_stage_xe_isolates():
     out = run_bench("--stage", "xe")
     assert out["metric"] == "xe_captions_per_sec_per_chip"

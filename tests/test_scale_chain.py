@@ -87,6 +87,21 @@ def test_scale_chain_main_micro(tmp_path):
     assert report["curves"]["xe"], "xe val curve missing from report"
     assert "xe" in report["beam"] and "CIDEr" in report["beam"]["xe"]
 
+    # collect_evidence snapshots the durable pieces with a manifest.
+    dest = tmp_path / "artifacts"
+    col = subprocess.run(
+        [sys.executable, "scripts/collect_evidence.py", "--out_dir",
+         str(out), "--name", "micro", "--dest", str(dest)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert col.returncode == 0, col.stderr[-2000:]
+    man = json.loads((dest / "micro" / "MANIFEST.json").read_text())
+    assert man["report_rc"] == 0
+    assert "scale_chain.py" in (man["regen_command"] or "")
+    for rel in ("xe/metrics.jsonl", "xe_beam5.json", "report.json",
+                "chain_events.jsonl"):
+        assert (dest / "micro" / rel).exists(), f"missing {rel}"
+        assert rel in man["files"]
+
 
 def test_chain_report_explains_blocked_chain(tmp_path):
     """A chain that has produced NO curves must still be explainable:
