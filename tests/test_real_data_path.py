@@ -1,0 +1,145 @@
+"""The REAL-dataset path, end to end, before the real data exists.
+
+tests/fixtures/mini_videodatainfo.json is a hand-written miniature of
+MSR-VTT's actual release format (``videos`` with a ``split`` field, a
+flat ``sentences`` list — SURVEY.md §7 step 2).  This test drives it
+through the ACTUAL CLIs a user would run the day real MSR-VTT lands:
+
+    converters (msrvtt) -> prepro (train vocab reused for val/test)
+    -> train.py (one XE stage with val) -> eval.py (beam on test)
+
+Features are written in-test: in the real pipeline they are
+pre-extracted CNN outputs the user supplies, not something these CLIs
+produce.  So when the dataset shows up, the ONLY new variable is the
+data itself (VERDICT r4, next #6).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import h5py
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "mini_videodatainfo.json")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import CACHE_DIR
+
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    return env
+
+
+def _run(cmd, env, timeout=600):
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{' '.join(cmd[:4])}... rc={proc.returncode}\n"
+        f"stdout:{proc.stdout[-2000:]}\nstderr:{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def _write_feats(info_json: str, path: str, dim: int = 8, t: int = 4):
+    """Pre-extracted-feature stand-in: rows follow the info json's video
+    order, exactly the contract real extracted features must meet."""
+    with open(info_json) as f:
+        vids = [v["id"] for v in json.load(f)["videos"]]
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(os.path.basename(path).encode()))
+    with h5py.File(path, "w") as f:
+        f.create_dataset(
+            "feats", data=rng.standard_normal(
+                (len(vids), t, dim)).astype(np.float32))
+    return path
+
+
+@pytest.mark.e2e
+def test_msrvtt_format_to_trained_eval(tmp_path):
+    env = _env()
+    pre = str(tmp_path / "mini_")
+
+    # 1. Official-format annotations -> per-split annotation JSONs.
+    out = _run([sys.executable, "-m", "cst_captioning_tpu.data.converters",
+                "--format", "msrvtt", "--input", FIXTURE,
+                "--out_prefix", pre], env)
+    written = json.loads(out)
+    assert set(written) == {"train", "val", "test"}
+
+    # 2. Offline prepro: train builds the vocab; val/test REUSE it (the
+    # reference's convention — val tokens outside the train vocab map to
+    # UNK instead of shifting ids).
+    d = str(tmp_path / "data")
+    paths = {}
+    for split in ("train", "val", "test"):
+        argv = [sys.executable, "-m", "cst_captioning_tpu.data.prepro",
+                "--annotations", written[split], "--split", split,
+                "--out_dir", d, "--max_len", "12"]
+        if split != "train":
+            argv += ["--vocab_json", paths["train"]["vocab_json"]]
+        paths[split] = json.loads(_run(argv, env))
+    assert os.path.exists(paths["train"]["cached_tokens"])
+    assert os.path.exists(paths["train"]["consensus_pkl"])
+
+    # Same vocab file contents for every split.
+    with open(paths["train"]["vocab_json"]) as f:
+        train_vocab = json.load(f)
+    with open(paths["test"]["vocab_json"]) as f:
+        assert json.load(f) == train_vocab
+
+    # 3. The user's pre-extracted features (2 modalities, like the
+    # reference's ResNet + C3D pairing).
+    feats = {}
+    for split in ("train", "val", "test"):
+        feats[split] = [
+            _write_feats(paths[split]["info_json"],
+                         str(tmp_path / f"{split}_feat{m}.h5"))
+            for m in range(2)
+        ]
+
+    # 4. One XE stage through the real trainer CLI, with val scoring.
+    ck = str(tmp_path / "ck")
+    _run([sys.executable, "train.py",
+          "--train_feat_h5", *feats["train"],
+          "--train_label_h5", paths["train"]["label_h5"],
+          "--train_info_json", paths["train"]["info_json"],
+          "--train_cocofmt_file", paths["train"]["cocofmt_json"],
+          "--val_feat_h5", *feats["val"],
+          "--val_label_h5", paths["val"]["label_h5"],
+          "--val_info_json", paths["val"]["info_json"],
+          "--val_cocofmt_file", paths["val"]["cocofmt_json"],
+          "--checkpoint_path", ck,
+          "--batch_size", "2", "--seq_per_img", "3", "--rnn_size", "16",
+          "--input_encoding_size", "16", "--att_size", "16",
+          "--max_length", "12", "--max_epochs", "2", "--log_every", "1"],
+         env)
+    with open(os.path.join(ck, "infos.json")) as f:
+        infos = json.load(f)
+    assert infos["last_step"] > 0
+
+    # 5. Beam eval on the held-out test split through the real eval CLI.
+    result = str(tmp_path / "test_beam.json")
+    _run([sys.executable, "eval.py",
+          "--checkpoint_path", ck,
+          "--test_feat_h5", *feats["test"],
+          "--test_label_h5", paths["test"]["label_h5"],
+          "--test_info_json", paths["test"]["info_json"],
+          "--test_cocofmt_file", paths["test"]["cocofmt_json"],
+          "--beam_size", "2", "--batch_size", "2", "--max_length", "12",
+          "--result_file", result], env)
+    with open(result) as f:
+        res = json.load(f)
+    scores = res["scores"]
+    for k in ("Bleu_1", "CIDEr", "ROUGE_L"):
+        assert k in scores and np.isfinite(scores[k])
+    # Predictions cover exactly the test split's videos.
+    pred_ids = {p["image_id"] for p in res["predictions"]}
+    with open(paths["test"]["info_json"]) as f:
+        assert pred_ids == {v["id"] for v in json.load(f)["videos"]}
