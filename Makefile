@@ -31,7 +31,8 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test xe wxe cst cst_scb cst_host eval bench demo scale_chain clean
+.PHONY: test xe wxe cst cst_scb cst_host eval bench demo scale_chain \
+        report collect chip_window clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -111,6 +112,20 @@ scale_chain:
 	$(PY) scripts/scale_chain.py --out_dir /tmp/cst_scale \
 	  --num_videos 6513 --num_val 497 --lr_decay_every 10 \
 	  --stages xe,wxe,cst,cst_scb_sample,eval
+
+# Chain status + learning curves + beam tables for the dir above.
+report:
+	$(PY) scripts/chain_report.py --out_dir /tmp/cst_scale
+
+# Snapshot the chain's durable evidence into artifacts/<NAME>.
+collect:
+	$(PY) scripts/collect_evidence.py --out_dir /tmp/cst_scale \
+	  --name $(or $(NAME),cst_scale)
+
+# Wait for the next healthy-tunnel window, then capture perf evidence
+# (phase costs, bench cache refresh, fused-step trace) automatically.
+chip_window:
+	$(PY) scripts/chip_window.py --out_dir /tmp/chip_window
 
 clean:
 	rm -rf $(OUT)
