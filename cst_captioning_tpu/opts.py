@@ -134,6 +134,14 @@ def _add_optim_args(p: argparse.ArgumentParser) -> None:
                    help="epochs between staircase lr decays; 0 disables")
     g.add_argument("--max_patience", type=int, default=5,
                    help="early-stop epochs without val improvement; 0 = off")
+    g.add_argument("--min_epochs", type=int, default=0,
+                   help="early stop cannot fire before this many epochs "
+                        "have run.  Guards small-steps-per-epoch runs "
+                        "where val scores tie at ~0 for many early epochs "
+                        "(greedy decode emits nothing scoreable yet), "
+                        "which otherwise exhausts patience before "
+                        "learning starts — observed at 64-video probe "
+                        "scale (4 steps/epoch)")
     g.add_argument("--seed", type=int, default=123)
 
 

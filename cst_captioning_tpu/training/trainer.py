@@ -741,7 +741,8 @@ class Trainer:
         # that crashes each epoch must early-stop at the same epoch as the
         # uninterrupted run (VERDICT r3 weak #4).
         patience = int(self.ckpt.infos.get("patience") or 0)
-        if opt.max_patience and patience >= opt.max_patience:
+        if (opt.max_patience and patience >= opt.max_patience
+                and start_step // bpe >= opt.min_epochs):
             # The stage ALREADY early-stopped in a previous run; re-running
             # it (e.g. the scale-chain recovery flow re-invoking every
             # stage) must be a no-op, not train bonus epochs whose noisy
@@ -818,7 +819,12 @@ class Trainer:
                                           "val_scores": scores,
                                           "patience": patience})
                     self._watchdog.beat()  # orbax fetch+write completed
-                    if opt.max_patience and patience >= opt.max_patience:
+                    # min_epochs floors the STOP, not the patience count:
+                    # epochs without improvement keep accumulating, but
+                    # the run cannot end while val scores may still be in
+                    # the early all-tie regime.
+                    if (opt.max_patience and patience >= opt.max_patience
+                            and (step + 1) // bpe >= opt.min_epochs):
                         log.info("early stop: no %s improvement in %d epochs",
                                  opt.eval_metric, patience)
                         break

@@ -374,6 +374,12 @@ def main() -> int:
                         "EXACT ties, so short patience fires early "
                         "(round-4 midscale probe stopped XE at 16/100 "
                         "epochs, well short of convergence)")
+    p.add_argument("--min_epochs", type=int, default=30,
+                   help="floor under early stopping for XE/WXE: at small "
+                        "steps-per-epoch scales val CIDEr ties at ~0 for "
+                        "many early epochs and patience would fire before "
+                        "learning starts (observed live at 64 videos / "
+                        "batch 16: stopped at epoch 18 with CIDEr 0.02)")
     p.add_argument("--lr_decay_every", type=int, default=25,
                    help="staircase decay period in epochs for XE/WXE "
                         "(the 640-video synthetic has ~1/10 the steps of "
@@ -462,6 +468,11 @@ def main() -> int:
         "--learning_rate_decay_every", str(args.lr_decay_every),
         "--learning_rate_decay_rate", "0.5",
     ]
+    # The early-stop floor exists for COLD-START training, whose first
+    # epochs sit in the all-tie val regime; WXE warm-starts from a
+    # converged XE and must keep normal early stopping (a 30-epoch floor
+    # would silently disable it under the 20-epoch default budget).
+    xe_floor = ["--min_epochs", str(min(args.min_epochs, args.xe_epochs))]
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
 
     def run_train_stage(tag, argv, label: str = ""):
@@ -495,7 +506,7 @@ def main() -> int:
 
     if "xe" in stages:
         run_train_stage("xe", [
-            *common, *xe_sched, "--checkpoint_path", f"{ckpt}/xe",
+            *common, *xe_sched, *xe_floor, "--checkpoint_path", f"{ckpt}/xe",
             "--max_epochs", str(args.xe_epochs),
             "--learning_rate", args.xe_lr,
         ])

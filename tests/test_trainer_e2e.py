@@ -230,6 +230,32 @@ def test_early_stop_patience_survives_resume(data, tmp_path_factory):
     assert rerun["best_score"] == res["best_score"]
 
 
+def test_min_epochs_floors_early_stop(data, tmp_path_factory):
+    """--min_epochs keeps patience from ending a run while val scores are
+    still in the early all-tie regime (observed live at probe scale:
+    4 steps/epoch, val CIDEr ties at ~0, patience fired at epoch 18 of a
+    run that converges by 150).  The floor gates the STOP only — the
+    patience counter itself keeps accumulating."""
+    out = str(tmp_path_factory.mktemp("minep"))
+    # lr 0 -> permanent plateau: patience 2 alone stops after epoch 3.
+    common = {"--learning_rate": ["0.0"], "--max_patience": ["2"]}
+
+    floored = run_stage(data, os.path.join(out, "floored"),
+                        **{**common, "--max_epochs": ["6"],
+                           "--min_epochs": ["5"]})
+    # bpe = 2 (8 videos / batch 4): stop fires at the first boundary at
+    # or past the floor — epoch 5, step 10 — not epoch 3, step 6.
+    assert floored["last_step"] == 10
+
+    # A stopped stage below the floor is NOT no-op'd on rerun with a
+    # raised floor: resume trains to the floor, then stops.
+    ckpt = os.path.join(out, "resume")
+    run_stage(data, ckpt, **{**common, "--max_epochs": ["4"]})
+    res = run_stage(data, ckpt, **{**common, "--max_epochs": ["8"],
+                                   "--min_epochs": ["6"]})
+    assert res["last_step"] == 12  # epoch 6: floor reached, stop fires
+
+
 def test_long_feature_stream_transformer(tmp_path_factory):
     """Config-5 shape check (SURVEY §6): minutes-long feature streams
     (T=192 frames) through attention-over-time, both decoders, without
