@@ -377,6 +377,10 @@ def parse_args():
                         "measured and reported either way")
     p.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ reward scorer (trainer default)")
+    p.add_argument("--cache", type=int, default=1,
+                   help="0 = do not persist this run to BENCH_TPU_CACHE "
+                        "(exploratory configs must not clobber the "
+                        "shipped-config entry the CPU fallback attaches)")
     p.add_argument("--platform", default="auto", choices=("auto", "device", "cpu"),
                    help="auto: probe the default backend, fall back to cpu; "
                         "device: require the probed backend; cpu: host only")
@@ -455,6 +459,9 @@ def _emit(result: dict, args) -> None:
     config = resolved_config(args)
     metric = result.get("metric")
     if result.get("platform") != "cpu":
+        if not args.cache:  # exploratory config: measured, not persisted
+            print(json.dumps(result))
+            return
         cache = {}
         try:
             if os.path.exists(TPU_CACHE):

@@ -147,8 +147,18 @@ def main() -> int:
         # our kill, holding the device as an orphan.
         env = dict(os.environ)
         env["_BENCH_CHILD"] = "1"
-        run_step("bench", [sys.executable, "bench.py"],
-                 args.out_dir, args.step_timeout, log, env=env)
+        if run_step("bench", [sys.executable, "bench.py"],
+                    args.out_dir, args.step_timeout, log, env=env):
+            # Scaling datapoint (only on a backend the default bench just
+            # proved alive): the fused step's per-timestep GEMMs are small
+            # at batch 32 (640 rows); doubling the batch may lift MXU
+            # utilization.  --cache 0 — an exploratory config must not
+            # clobber the shipped-config cache entry the CPU fallback
+            # attaches.
+            run_step("bench_cst_b64",
+                     [sys.executable, "bench.py", "--stage", "cst",
+                      "--batch_size", "64", "--cache", "0"],
+                     args.out_dir, args.step_timeout, log, env=env)
     if not args.skip_trace:
         trace_dir = os.path.join(args.out_dir, "fused_trace")
         code = TRACE_FUSED.format(repo=REPO, trace_dir=trace_dir)
