@@ -19,6 +19,15 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _import_chain_report():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import chain_report
+    finally:
+        sys.path.pop(0)
+    return chain_report
+
+
 def _cpu_env():
     env = dict(os.environ)
     env["PYTHONPATH"] = ""
@@ -107,12 +116,7 @@ def test_chain_report_explains_blocked_chain(tmp_path):
     """A chain that has produced NO curves must still be explainable:
     the report derives 'wedged since when, how many probes' from the
     event log instead of printing an empty table (VERDICT r4, weak #1)."""
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import chain_report
-    finally:
-        sys.path.pop(0)
-
+    chain_report = _import_chain_report()
     out = tmp_path / "blocked"
     out.mkdir()
     t0 = 1000.0
@@ -150,15 +154,38 @@ def test_chain_report_explains_blocked_chain(tmp_path):
     assert status2["state"] == "complete"
 
 
+def test_chain_status_heal_and_abort_states():
+    """The status fold distinguishes healing (device back, stage about to
+    resume) from wedged, and an abort pins its reason to the stage."""
+    chain_report = _import_chain_report()
+    t0 = 2000.0
+    base = [
+        {"ts": t0, "event": "chain_start", "argv": [], "stages": "xe"},
+        {"ts": t0 + 1, "event": "stage_start", "tag": "xe"},
+        {"ts": t0 + 2, "event": "attempt_start", "tag": "xe", "attempt": 1},
+        {"ts": t0 + 50, "event": "wedge", "tag": "xe", "rc": 124},
+        {"ts": t0 + 100, "event": "probe", "tag": "xe", "verdict": "wedged"},
+        {"ts": t0 + 200, "event": "probe", "tag": "xe", "verdict": "ok"},
+        {"ts": t0 + 201, "event": "healed", "tag": "xe", "waited_s": 151.0},
+    ]
+    st = chain_report.chain_status(base, now=t0 + 230)
+    assert st["state"] == "healing" and st["stage"] == "xe"
+
+    aborted = base + [
+        {"ts": t0 + 300, "event": "attempt_start", "tag": "xe", "attempt": 2},
+        {"ts": t0 + 400, "event": "stage_abort", "tag": "xe",
+         "reason": "no_progress_cap"},
+    ]
+    st2 = chain_report.chain_status(aborted, now=t0 + 500)
+    assert st2["state"] == "aborted"
+    assert st2["stages"]["xe"]["abort"] == "no_progress_cap"
+    assert "no_progress_cap" in st2["detail"]
+
+
 def test_chain_report_parses_console_log_fallback(tmp_path):
     """Chains started before the event log existed (the live r4b chain)
     are still diagnosable from their console markers."""
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import chain_report
-    finally:
-        sys.path.pop(0)
-
+    chain_report = _import_chain_report()
     log = tmp_path / "chain.log"
     log.write_text(
         "reusing dataset in /tmp/x/data\n"
