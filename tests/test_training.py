@@ -365,3 +365,65 @@ class TestCheckpoint:
         with pytest.raises(FileNotFoundError):
             mgr.restore(state)
         mgr.close()
+
+
+class TestAdvantageRegimeDetector:
+    """The trainer warns ONCE, early, when every logged advantage is
+    negative — the greedy-baseline degeneration regime observed live at
+    512-video scale (reward 0.12 vs baseline 0.26 -> collapse)."""
+
+    def _detector(self):
+        import types
+
+        from cst_captioning_tpu.training.trainer import Trainer
+
+        obj = types.SimpleNamespace(_ADV_WARN_STEPS=Trainer._ADV_WARN_STEPS)
+        return obj, lambda m: Trainer._check_advantage_regime(obj, m)
+
+    def test_warns_on_all_negative_advantages(self, caplog):
+        obj, check = self._detector()
+        with caplog.at_level("WARNING",
+                             logger="cst_captioning_tpu.train"):
+            for _ in range(5):
+                check({"advantage": -0.15, "reward": 0.1, "baseline": 0.25})
+        assert any("advantage has been negative" in r.message
+                   for r in caplog.records)
+        assert obj._adv_warned
+        # One warning only: further steps stay silent.
+        n = len(caplog.records)
+        check({"advantage": -0.2, "reward": 0.05, "baseline": 0.25})
+        assert len(caplog.records) == n
+
+    def test_silent_when_any_advantage_positive(self, caplog):
+        _, check = self._detector()
+        with caplog.at_level("WARNING",
+                             logger="cst_captioning_tpu.train"):
+            for i in range(6):
+                check({"advantage": -0.2 if i % 2 else 0.05,
+                       "reward": 0.2, "baseline": 0.2})
+        assert not caplog.records
+
+    def test_silent_when_mean_is_mild(self, caplog):
+        _, check = self._detector()
+        with caplog.at_level("WARNING",
+                             logger="cst_captioning_tpu.train"):
+            for _ in range(6):
+                check({"advantage": -0.01, "reward": 0.2, "baseline": 0.21})
+        assert not caplog.records
+
+    def test_ignores_xe_metrics(self, caplog):
+        _, check = self._detector()
+        with caplog.at_level("WARNING",
+                             logger="cst_captioning_tpu.train"):
+            for _ in range(8):
+                check({"loss": 4.2})
+        assert not caplog.records
+
+    def test_one_early_noise_positive_only_delays_detection(self, caplog):
+        obj, check = self._detector()
+        with caplog.at_level("WARNING", logger="cst_captioning_tpu.train"):
+            check({"advantage": 0.001, "reward": 0.2, "baseline": 0.2})
+            for _ in range(5):  # window slides past the noise positive
+                check({"advantage": -0.2, "reward": 0.1, "baseline": 0.3})
+        assert any("advantage has been negative" in r.message
+                   for r in caplog.records)
