@@ -404,6 +404,10 @@ def main() -> int:
     p.add_argument("--feat_dims", type=int, nargs="+", default=[2048, 4096])
     p.add_argument("--feat_times", type=int, nargs="+", default=[28, 1])
     p.add_argument("--xe_lr", default="2e-4")
+    p.add_argument("--seed", type=int, default=123,
+                   help="training seed passed to every stage (reproduce a "
+                        "chain exactly, or rerun it at a new seed for "
+                        "robustness evidence)")
     p.add_argument("--wedge_timeout", type=float, default=1500.0,
                    help="trainer watchdog (seconds without loop progress "
                         "-> exit 124 -> harness resume); must exceed the "
@@ -461,6 +465,7 @@ def main() -> int:
         "--use_bfloat16", "1", "--device_feats", args.device_feats,
         "--save_every_steps", "100",  # tunnel-wedge recovery granularity
         "--log_every", "10", "--fast_val", "1",
+        "--seed", str(args.seed),
         "--wedge_timeout", str(args.wedge_timeout),
     ]
     xe_sched = [
