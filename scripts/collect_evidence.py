@@ -32,10 +32,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-STAGES = ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample")
 
 
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from chain_report import STAGES  # noqa: E402  (one stage list)
 from cst_captioning_tpu.utils.platform import git_head_sha  # noqa: E402
 
 

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""One table across every committed evidence bundle.
+
+Reads ``artifacts/*/report.json`` (written by collect_evidence) and
+prints, per bundle: the dataset scale, each stage's best greedy
+fast-val score, and each stage's held-out beam-5 score on the chosen
+metric — the cross-scale view of the evidence ladder that individual
+chain reports can't show.
+
+Usage: python scripts/compare_bundles.py [--root artifacts] [--metric CIDEr]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from chain_report import STAGES  # noqa: E402  (one stage list, not three)
+
+
+def load_bundles(root: str):
+    bundles = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for name in names:
+        d = os.path.join(root, name)
+        rj = os.path.join(d, "report.json")
+        if not os.path.isfile(rj):
+            continue
+        try:
+            with open(rj) as f:
+                report = json.load(f)
+        except ValueError:
+            continue
+        spec = {}
+        try:
+            with open(os.path.join(d, "SCALE_SPEC.json")) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            pass
+        bundles.append((name, spec, report))
+    return bundles
+
+
+def fmt(v) -> str:
+    return f"{v:.4f}" if isinstance(v, (int, float)) else "—"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=os.path.join(REPO, "artifacts"))
+    ap.add_argument("--metric", default="CIDEr")
+    args = ap.parse_args()
+    bundles = load_bundles(args.root)
+    if not bundles:
+        print(f"no bundles with report.json under {args.root}")
+        return 1
+
+    print(f"## Evidence ladder — best val / beam-5 {args.metric} per stage\n")
+    print("| bundle | videos | " + " | ".join(STAGES) + " |")
+    print("|---" * (len(STAGES) + 2) + "|")
+    for name, spec, report in bundles:
+        cells = []
+        for stage in STAGES:
+            curve = report.get("curves", {}).get(stage) or []
+            best = max((r.get(args.metric) for r in curve
+                        if isinstance(r.get(args.metric), (int, float))),
+                       default=None)
+            beam = (report.get("beam", {}).get(stage) or {}).get(args.metric)
+            cells.append(f"{fmt(best)} / {fmt(beam)}")
+        videos = spec.get("num_videos", "—")
+        print(f"| {name} | {videos} | " + " | ".join(cells) + " |")
+    print("\n(cell = best greedy fast-val / held-out beam-5; — = value "
+          "not in the bundle: stage absent, or — for the val half — the "
+          "curves were recorded under a different --metric)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -211,3 +211,18 @@ def test_chain_report_parses_console_log_fallback(tmp_path):
     log.write_text(log.read_text() + "=== xe done: best 3.2 @ step 40 ===\n")
     st3 = chain_report.log_status(str(log))
     assert st3["state"] == "running" and st3["counts"]["done"] == 1
+
+
+def test_compare_bundles_reads_committed_artifacts():
+    """The cross-bundle ladder table renders from the committed
+    artifacts/ bundles (and any new ones) without error."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/compare_bundles.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "Evidence ladder" in proc.stdout
+    # Every committed bundle appears as a row.
+    for name in ("probe64", "mid128", "cpu512"):
+        assert f"| {name} |" in proc.stdout
+    # probe64's known xe val best renders in its cell.
+    assert "0.5032" in proc.stdout
