@@ -308,10 +308,28 @@ class TestRichSyntheticGrammar:
 
         spec = SyntheticSpec(num_videos=40, captions_per_video=6,
                              max_len=30, feat_dims=(32,), feat_times=(2,),
-                             rich_vocab=60)  # small pools, many videos
+                             rich_vocab=30)  # tiny pools -> median ~6
         with caplog.at_level(logging.WARNING,
                              logger="cst_captioning_tpu.data.synthetic"):
             generate(str(tmp_path / "healthy"), "train", spec)
+        assert not any("DEGENERATE" in r.message for r in caplog.records)
+        assert not any("THIN word exposure" in r.message
+                       for r in caplog.records)
+
+    def test_thin_word_exposure_warns(self, tmp_path, caplog):
+        """Median videos-per-word in (1, 4) is the template-collapse zone
+        (round-5 field: median 2 at 512 videos x 1500 pools -> beam
+        decodes collapsed to 6 function-word templates): warn, with a
+        distinct message from the hard DEGENERATE case."""
+        import logging
+
+        spec = SyntheticSpec(num_videos=40, captions_per_video=6,
+                             max_len=30, feat_dims=(32,), feat_times=(2,),
+                             rich_vocab=60)  # pools sized for median ~3
+        with caplog.at_level(logging.WARNING,
+                             logger="cst_captioning_tpu.data.synthetic"):
+            generate(str(tmp_path / "thin"), "train", spec)
+        assert any("THIN word exposure" in r.message for r in caplog.records)
         assert not any("DEGENERATE" in r.message for r in caplog.records)
 
     def test_val_vocabulary_subset_of_train(self, tmp_path):
