@@ -226,3 +226,39 @@ def test_compare_bundles_reads_committed_artifacts():
         assert f"| {name} |" in proc.stdout
     # probe64's known xe val best renders in its cell.
     assert "0.5032" in proc.stdout
+
+
+def test_event_log_edge_cases(tmp_path):
+    """The event log is evidence infrastructure: it must never kill the
+    harness (unwritable path -> silent no-op), must append well-formed
+    JSON lines, and load_events must skip a torn tail line (killed
+    harness mid-write) without losing the rest."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scale_chain", os.path.join(REPO, "scripts", "scale_chain.py"))
+    scale_chain = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(scale_chain)
+    chain_report = _import_chain_report()
+
+    # Disabled log (path None): every emit is a no-op.
+    scale_chain.EventLog(None).emit("chain_start", argv=[])
+
+    # Unwritable path: swallowed, harness survives.
+    bad = scale_chain.EventLog(str(tmp_path / "no" / "such" / "dir" / "e.jsonl"))
+    bad.emit("chain_start", argv=[])
+
+    # Normal appends round-trip through load_events...
+    out = tmp_path / "run"
+    out.mkdir()
+    log = scale_chain.EventLog(str(out / "chain_events.jsonl"))
+    log.emit("chain_start", argv=["--x"], stages="xe")
+    log.emit("stage_start", tag="xe")
+    # ...and a torn tail (SIGKILL mid-write) is skipped, not fatal.
+    with open(out / "chain_events.jsonl", "a") as f:
+        f.write('{"ts": 1, "event": "attempt_st')
+    events = chain_report.load_events(str(out))
+    assert [e["event"] for e in events] == ["chain_start", "stage_start"]
+    status = chain_report.chain_status(events, now=events[-1]["ts"] + 10.0)
+    assert status["state"] == "running" and status["stage"] == "xe"
+    assert status["last_event_age_s"] == pytest.approx(10.0, abs=1.0)
