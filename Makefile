@@ -31,11 +31,22 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test xe wxe cst cst_scb cst_host eval bench demo scale_chain \
+.PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo scale_chain \
         report collect chip_window clean
 
+# Default tier: everything except the `slow` subprocess chaos drills —
+# the same selection the tier-1 verify uses; `make chaos` runs the rest.
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# Chaos drills (RESILIENCE.md): drive the real trainer through injected
+# faults — torn checkpoints, NaN gradients, loader errors, wedges — and
+# assert end-to-end recovery.  Includes the `slow` subprocess drills that
+# the default `pytest -m 'not slow'` (tier-1) skips; the fast subset of
+# tests/test_resilience.py rides in tier-1 automatically.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
+	  tests/test_watchdog.py -q
 
 # -- three-stage recipe (XE -> WXE -> CST) --------------------------------
 

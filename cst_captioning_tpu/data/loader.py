@@ -17,14 +17,24 @@ Responsibilities (SURVEY.md §3.5, restated for XLA):
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..resilience.faults import FaultPlan, InjectedFault
 from .dataset import CaptionDataset
+
+log = logging.getLogger("cst_captioning_tpu.loader")
+
+#: Error classes the prefetch worker treats as TRANSIENT (retry with
+#: backoff before poisoning the stream): h5py surfaces flaky NFS/FUSE
+#: reads as OSError/IOError, and the chaos harness injects the same shape.
+TRANSIENT_ERRORS = (OSError,)
 
 
 @dataclass
@@ -59,8 +69,14 @@ class CaptionLoader:
         process_count: int = 1,
         include_gts: bool = False,
         include_feats: bool = True,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         self.ds = dataset
+        # Chaos hook (resilience/faults.py): ``loader_err@batch=N`` raises
+        # a transient error from batch N's feature read.  None = disarmed,
+        # one host-side None-check per batch.
+        self._faults = fault_plan
+        self._batches_served = 0
         self.batch_size = batch_size
         self.seq_per_img = seq_per_img
         self.shuffle = shuffle
@@ -126,6 +142,11 @@ class CaptionLoader:
         return caps[sel], sel
 
     def next_batch(self) -> Batch:
+        if (self._faults is not None
+                and self._faults.fire("loader_err", self._batches_served)):
+            raise InjectedFault(
+                f"injected transient feature-read error at batch "
+                f"{self._batches_served}")
         ix = self._next_indices(self.batch_size)
         feats = self.ds.features(ix) if self.include_feats else []
         labels = np.zeros((self.batch_size * self.seq_per_img, self.ds.seq_length),
@@ -143,6 +164,7 @@ class CaptionLoader:
         gts = {}
         if self.include_gts and self._refs is not None:
             gts = {vid: self._refs[vid] for vid in vids if vid in self._refs}
+        self._batches_served += 1
         return Batch(feats=feats, labels=labels, weights=weights,
                      video_ids=vids, gts=gts, video_ix=ix)
 
@@ -173,8 +195,10 @@ class CaptionLoader:
             )
 
 
-def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
-                       device_put=None, feat_dtype=None) -> Iterator[Batch]:
+def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
+                       size: int = 2, device_put=None, feat_dtype=None,
+                       retries: int = 3,
+                       retry_backoff_s: float = 0.05) -> Iterator[Batch]:
     """Run batch assembly (h5 reads, numpy packing) in a background thread,
     optionally applying ``device_put`` (e.g. a sharding-aware jax.device_put)
     to feats/labels/weights before handing the batch to the consumer.
@@ -187,10 +211,54 @@ def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
     the features are cast to the model dtype on device anyway, so when the
     model runs bf16 this only moves the (value-preserving) cast before the
     wire.  Labels/weights are untouched.
+
+    Transient-error policy: when ``batches`` is a loader (anything with a
+    ``next_batch`` method, so the producing call can be re-issued), a
+    ``TRANSIENT_ERRORS`` failure during batch assembly is retried up to
+    ``retries`` times with exponential backoff before the poison-pill
+    exception propagates — a single flaky NFS read must not kill a
+    multi-hour run.  A retried batch redraws from the (infinite,
+    wrap-around) stream, which only reorders coverage within the epoch.
+    Plain iterators keep the old fail-fast contract: a generator is dead
+    after it raises, so retrying it would silently end the stream instead
+    of surfacing the error.
+
+    Worker lifetime: abandoning the iterator (break / GeneratorExit) wakes
+    the worker via the ``closed`` event and JOINS it, so no thread — and no
+    prefetched HBM buffer it holds — outlives the consumer.
     """
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = object()
     closed = threading.Event()  # consumer gone: worker must drop its buffers
+
+    next_batch = getattr(batches, "next_batch", None)
+    if next_batch is None:
+        it = iter(batches)
+        retries = 0  # see docstring: a raised-through generator is dead
+
+        def produce() -> Optional[Batch]:
+            try:
+                return next(it)
+            except StopIteration:
+                return None
+    else:
+        def produce() -> Optional[Batch]:
+            return next_batch()
+
+    def produce_with_retry() -> Optional[Batch]:
+        delay = retry_backoff_s
+        for attempt in range(retries + 1):
+            try:
+                return produce()
+            except TRANSIENT_ERRORS as e:
+                if attempt >= retries or closed.is_set():
+                    raise
+                log.warning(
+                    "transient batch-read error (%s); retry %d/%d in %.2fs",
+                    e, attempt + 1, retries, delay)
+                time.sleep(delay)
+                delay *= 2
+        return None  # unreachable; keeps type checkers honest
 
     def _put(item) -> bool:
         while not closed.is_set():
@@ -203,7 +271,10 @@ def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
 
     def work():
         try:
-            for b in batches:
+            while not closed.is_set():
+                b = produce_with_retry()
+                if b is None:  # finite source exhausted
+                    break
                 if feat_dtype is not None:
                     b = Batch(
                         feats=[np.asarray(f).astype(feat_dtype) for f in b.feats],
@@ -236,11 +307,20 @@ def prefetch_to_device(batches: Iterator[Batch], size: int = 2,
                 raise item
             yield item
     finally:
-        # Consumers of the infinite stream exit via break/GeneratorExit; wake
-        # the worker so it stops holding prefetched (possibly HBM) buffers.
+        # Consumers of the infinite stream exit via break/GeneratorExit:
+        # wake the worker, drain whatever it already queued, and reap the
+        # thread so neither it nor its prefetched buffers leak.  The reap
+        # is deadline-bounded — a worker wedged inside a dead-transport
+        # read must not transfer its hang to the consumer (it is a daemon
+        # thread; the deadline only abandons the join, not the wake-up).
         closed.set()
-        while not q.empty():
+        deadline = time.monotonic() + 5.0
+        while True:
             try:
                 q.get_nowait()
+                continue  # drained one item; worker may be mid-_put
             except queue.Empty:
+                pass
+            if not t.is_alive() or time.monotonic() > deadline:
                 break
+            t.join(timeout=0.2)

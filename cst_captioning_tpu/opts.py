@@ -262,7 +262,41 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--profile_steps", type=int, default=10,
                    help="number of steps to trace")
     g.add_argument("--debug_nans", type=int, default=0,
-                   help="1 = jax_debug_nans (fail fast on NaN; test mode)")
+                   help="1 = jax_debug_nans (crash on the FIRST NaN with a "
+                        "traceback; debugging mode).  Mutually exclusive "
+                        "with --divergence_guard: the crash preempts the "
+                        "guard's skip-and-rollback, so setting both warns "
+                        "and disables the guard")
+
+
+def _add_resilience_args(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("resilience")
+    g.add_argument("--divergence_guard", type=int, default=1,
+                   help="1 (default) = fold a finite-check of loss + grad "
+                        "global-norm into the compiled train step: a "
+                        "non-finite step is skipped ON DEVICE (params/"
+                        "optimizer state keep their pre-step values) and "
+                        "after --divergence_max_bad consecutive bad steps "
+                        "the trainer rolls back to the last verified "
+                        "checkpoint with a re-seeded rollout key stream.  "
+                        "Disabled automatically under --debug_nans "
+                        "(which crashes on the first NaN instead)")
+    g.add_argument("--divergence_max_bad", type=int, default=3,
+                   help="consecutive non-finite steps before the guard "
+                        "rolls back to the last known-good checkpoint")
+    g.add_argument("--divergence_max_rollbacks", type=int, default=2,
+                   help="rollbacks before the run aborts as unrecoverable "
+                        "(a deterministic divergence would otherwise "
+                        "replay forever)")
+    g.add_argument("--fault_plan", default=None,
+                   help="CHAOS TESTING ONLY: comma-separated deterministic "
+                        "fault specs injected into this run, e.g. "
+                        "'ckpt_torn@step=40,nan_grad@step=55,"
+                        "loader_err@batch=12,wedge@step=70' (kind@step=N, "
+                        "kind@batch=N, or kind@step=N*K for K consecutive "
+                        "firings; grammar + taxonomy in RESILIENCE.md).  "
+                        "Falls back to the CST_FAULT_PLAN env var; unset = "
+                        "every hook disarmed at zero cost")
 
 
 def _add_tpu_args(p: argparse.ArgumentParser) -> None:
@@ -288,6 +322,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_cst_args(p)
     _add_decode_args(p)
     _add_bookkeeping_args(p)
+    _add_resilience_args(p)
     _add_tpu_args(p)
     return p
 

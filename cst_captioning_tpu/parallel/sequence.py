@@ -52,6 +52,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS
 
+# jax moved shard_map out of experimental (and renamed check_rep ->
+# check_vma) around 0.5/0.6; support both so the SP path works on the
+# installed 0.4.x as well as newer runtimes.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+    _SM_CHECK_KW = "check_vma"
+else:  # pragma: no cover - exercised on jax<0.5 installs (like this one)
+    from jax.experimental.shard_map import shard_map
+
+    _SM_CHECK_KW = "check_rep"
+
+
+def _axis_size(axis_name: str) -> int:
+    """jax.lax.axis_size appeared after 0.4.x; psum(1) is the portable
+    spelling of "how many shards on this axis" inside shard_map."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 _NEG_INF = -1e30  # finite "masked" score: keeps pmax/exp NaN-free when a
                   # whole shard (or a whole row) is padding
 
@@ -146,7 +165,7 @@ def ring_cross_attention(
     preferred when even the psum of the (B, Lq, Dv) numerator is a
     concern, or as the building block for future Q-sharded self-attention
     over long streams."""
-    n_shards = jax.lax.axis_size(axis_name)
+    n_shards = _axis_size(axis_name)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     qf = q.astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
@@ -211,7 +230,7 @@ def sp_cross_attention_jit(mesh: Mesh, ring: bool = False):
                  axis_name=MODEL_AXIS)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS, MODEL_AXIS),
                   P(DATA_AXIS, MODEL_AXIS), P(DATA_AXIS, MODEL_AXIS)),
@@ -220,7 +239,7 @@ def sp_cross_attention_jit(mesh: Mesh, ring: bool = False):
         # axis by construction (every device folds every block), but that
         # is invisible to the static varying-axes check — the combine
         # version's psum proves it, the ring's ppermute loop cannot.
-        check_vma=not ring,
+        **{_SM_CHECK_KW: not ring},
     )
     def mapped(q, k, v, mask):
         return fn(q, k, v, mask=mask)

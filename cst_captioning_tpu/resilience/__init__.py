@@ -1,0 +1,38 @@
+"""Resilience subsystem: fault injection, divergence guard, checkpoint integrity.
+
+Three layers, each owning one class of failure (taxonomy + ownership table
+in RESILIENCE.md):
+
+- :mod:`faults` — a deterministic, CLI/env-armed fault-injection plan
+  (``--fault_plan 'ckpt_torn@step=40,nan_grad@step=55,...'``) whose hooks
+  live at the host-side seams of the trainer (checkpoint commit, loader
+  read, step dispatch, train loop) and cost nothing when disarmed;
+- :mod:`guard` — the host half of the divergence guard: consumes the
+  ``bad_step`` flag the guarded train steps compute on device, counts
+  consecutive bad steps with a lagged (non-blocking) fetch, and decides
+  when to roll back to the last known-good checkpoint;
+- :mod:`integrity` — per-step checkpoint manifests (content checksums
+  written after the orbax commit), verify-on-restore, and the newest-
+  verified-step walk-back that keeps auto-resume off torn checkpoints.
+"""
+
+from .faults import FaultPlan, FaultSpec, InjectedFault
+from .guard import DivergenceGuard, DivergenceUnrecoverable
+from .integrity import (
+    MANIFEST_NAME,
+    manifest_path,
+    verify_step_dir,
+    write_manifest,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "DivergenceGuard",
+    "DivergenceUnrecoverable",
+    "MANIFEST_NAME",
+    "manifest_path",
+    "verify_step_dir",
+    "write_manifest",
+]

@@ -1,0 +1,180 @@
+"""Deterministic fault-injection plans for chaos-testing the trainer.
+
+A :class:`FaultPlan` is parsed from the ``--fault_plan`` CLI flag (or the
+``CST_FAULT_PLAN`` environment variable) and threaded EXPLICITLY into the
+components that host an injection point — no module-global arming, so two
+Trainers in one test process can never leak faults into each other.  Every
+injection site follows the same shape::
+
+    if plan is not None and plan.fire("kind", index):
+        <raise / corrupt / block>
+
+so a run without ``--fault_plan`` pays exactly one ``is not None`` check
+per site, all on the host, never inside a jitted program.
+
+Grammar (comma-separated specs)::
+
+    kind@step=N        fire once when the trainer dispatches step N (0-based)
+    kind@batch=N       fire once when the loader assembles batch N (0-based)
+    kind@step=N*K      fire on steps N, N+1, ..., N+K-1 (K consecutive)
+
+Registered kinds and the index they key on:
+
+==============  =======  ====================================================
+kind            keys on  effect at the injection site
+==============  =======  ====================================================
+``ckpt_torn``   step     truncate a payload file of the just-committed
+                         checkpoint AFTER its manifest was written — a torn
+                         write the integrity layer must catch on restore
+``nan_grad``    step     corrupt the step's host-side inputs to NaN so the
+                         device computes a non-finite loss/gradient
+``loader_err``  batch    raise a transient OSError from the loader's feature
+                         read (the prefetch retry path must absorb it)
+``wedge``       step     block the train loop forever (the watchdog must
+                         turn this into a fast exit 124)
+==============  =======  ====================================================
+
+Firing is deterministic and single-shot per (kind, index): a plan replayed
+after a rollback does not re-fire indices it already consumed, so chaos
+tests converge instead of re-injecting forever.  The consumed set is
+process-local by default; ``bind_state(path)`` persists it as JSONL next
+to the checkpoints, so a drill that kills its own process (``wedge``) is
+also single-shot across the resume attempts a recovery harness spawns —
+without it, ``scale_chain --fault_plan wedge@step=N`` would wedge every
+attempt forever.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+log = logging.getLogger("cst_captioning_tpu.resilience.faults")
+
+#: kind -> the index axis its specs must use.
+KINDS: Dict[str, str] = {
+    "ckpt_torn": "step",
+    "nan_grad": "step",
+    "loader_err": "batch",
+    "wedge": "step",
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<axis>step|batch)=(?P<at>\d+)(\*(?P<times>\d+))?$"
+)
+
+
+class InjectedFault(OSError):
+    """Raised by injection sites that simulate a transient I/O failure."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One armed fault: ``kind`` fires at indices ``at .. at+times-1``."""
+
+    kind: str
+    at: int
+    times: int = 1
+
+    def covers(self, index: int) -> bool:
+        return self.at <= index < self.at + self.times
+
+    def __str__(self) -> str:
+        axis = KINDS[self.kind]
+        tail = f"*{self.times}" if self.times != 1 else ""
+        return f"{self.kind}@{axis}={self.at}{tail}"
+
+
+@dataclass
+class FaultPlan:
+    """Parsed, consumable fault plan.  ``fire`` is the single runtime API."""
+
+    specs: List[FaultSpec]
+    _consumed: Set[Tuple[str, int]] = field(default_factory=set)
+    _state_path: Optional[str] = None
+
+    def bind_state(self, path: str) -> "FaultPlan":
+        """Persist consumed firings to ``path`` (JSONL, append-only) and
+        load any prior process's firings from it — the cross-process half
+        of single-shot semantics (a wedge drill's resume attempt must not
+        re-wedge).  Best-effort IO: chaos bookkeeping must never kill the
+        run it is testing."""
+        self._state_path = path
+        try:
+            with open(path) as f:
+                for line in f:
+                    kind, ix = json.loads(line)
+                    self._consumed.add((kind, int(ix)))
+        except (OSError, ValueError):
+            pass
+        return self
+
+    @classmethod
+    def parse(cls, text: Optional[str]) -> Optional["FaultPlan"]:
+        """``None``/empty -> ``None`` (disarmed); bad grammar -> ValueError
+        naming the offending spec — a chaos drill with a typo'd plan must
+        fail at startup, not silently run fault-free."""
+        if not text or not text.strip():
+            return None
+        specs = []
+        for raw in text.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _SPEC_RE.match(raw)
+            if m is None:
+                raise ValueError(
+                    f"bad fault spec {raw!r}; expected kind@step=N, "
+                    f"kind@batch=N, or kind@step=N*K with kind in "
+                    f"{sorted(KINDS)}")
+            kind, axis = m.group("kind"), m.group("axis")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; registered: {sorted(KINDS)}")
+            if KINDS[kind] != axis:
+                raise ValueError(
+                    f"fault {kind!r} keys on {KINDS[kind]!r}, not {axis!r}")
+            specs.append(FaultSpec(kind, int(m.group("at")),
+                                   int(m.group("times") or 1)))
+        return cls(specs=specs) if specs else None
+
+    def fire(self, kind: str, index: int) -> bool:
+        """True exactly once per (kind, index) covered by a spec.  The
+        consumed set makes replays after rollback/resume fault-free."""
+        key = (kind, int(index))
+        if key in self._consumed:
+            return False
+        for spec in self.specs:
+            if spec.kind == kind and spec.covers(index):
+                self._consumed.add(key)
+                if self._state_path is not None:
+                    # Record BEFORE the fault acts: a wedge kills the
+                    # process, and the resume attempt must see it spent.
+                    try:
+                        with open(self._state_path, "a") as f:
+                            f.write(json.dumps([kind, int(index)]) + "\n")
+                            f.flush()
+                            os.fsync(f.fileno())
+                    except OSError:
+                        pass
+                log.warning("FAULT INJECTED: %s fired at %s=%d (spec %s)",
+                            kind, KINDS[kind], index, spec)
+                return True
+        return False
+
+    def pending(self, kind: str) -> int:
+        """Indices of ``kind`` armed but not yet consumed (test assertions)."""
+        n = 0
+        for spec in self.specs:
+            if spec.kind != kind:
+                continue
+            n += sum(1 for i in range(spec.at, spec.at + spec.times)
+                     if (kind, i) not in self._consumed)
+        return n
+
+    def __str__(self) -> str:
+        return ",".join(str(s) for s in self.specs)

@@ -1,0 +1,103 @@
+"""Host half of the divergence guard: lagged bad-step accounting + rollback.
+
+The device half lives in ``training/steps.py``: guarded step factories fold
+an ``isfinite(loss) & isfinite(grad_norm)`` check into the compiled program
+and mask out the parameter/optimizer update when it fails, emitting a
+``bad_step`` metric (0.0/1.0).  That keeps the skip decision entirely
+on-device — no extra host sync in the step.
+
+This class consumes those ``bad_step`` device scalars WITHOUT stalling the
+dispatch loop: ``observe`` starts an async device->host copy and queues the
+array; ``poll`` only blocks on entries at least ``lag`` steps old, whose
+step has long since completed, so the fetch is a reap, not a wait.  After
+``max_bad`` CONSECUTIVE bad steps it asks the trainer to roll back to the
+last known-good checkpoint; ``max_rollbacks`` bounds how often that can
+happen before the run is declared unrecoverable (a deterministic divergence
+replaying forever would otherwise silently loop).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("cst_captioning_tpu.resilience.guard")
+
+
+class DivergenceUnrecoverable(RuntimeError):
+    """Raised when divergence persists past the rollback budget."""
+
+
+class DivergenceGuard:
+    """Counts consecutive non-finite steps; decides skip vs rollback."""
+
+    def __init__(self, max_bad: int = 3, max_rollbacks: int = 2,
+                 lag: int = 1):
+        self.max_bad = max(1, int(max_bad))
+        self.max_rollbacks = max(0, int(max_rollbacks))
+        self.lag = max(0, int(lag))
+        self._queue: Deque[Tuple[int, object]] = deque()
+        self.consecutive = 0
+        self.total_skipped = 0
+        self.rollbacks = 0
+        self.last_bad_step: Optional[int] = None
+
+    # -- ingestion ---------------------------------------------------------
+
+    def observe(self, step_ix: int, bad_step) -> None:
+        """Queue one step's ``bad_step`` device scalar (may be None when the
+        step ran unguarded, e.g. a legacy factory)."""
+        if bad_step is None:
+            return
+        if hasattr(bad_step, "copy_to_host_async"):
+            bad_step.copy_to_host_async()  # overlap the fetch with step t+1
+        self._queue.append((int(step_ix), bad_step))
+
+    def _reap_one(self) -> None:
+        step_ix, arr = self._queue.popleft()
+        bad = float(np.asarray(arr)) > 0.0
+        if bad:
+            self.consecutive += 1
+            self.total_skipped += 1
+            self.last_bad_step = step_ix
+            log.warning(
+                "divergence guard: non-finite loss/grad at step %d — update "
+                "skipped on device (%d consecutive, %d total)",
+                step_ix + 1, self.consecutive, self.total_skipped)
+        else:
+            self.consecutive = 0
+
+    # -- decisions ---------------------------------------------------------
+
+    def poll(self) -> bool:
+        """Reap every entry older than ``lag`` steps; True when the
+        consecutive-bad threshold is crossed (trainer should roll back)."""
+        while len(self._queue) > self.lag:
+            self._reap_one()
+        return self.consecutive >= self.max_bad
+
+    def flush(self) -> bool:
+        """Reap everything (epoch boundary / end of run)."""
+        while self._queue:
+            self._reap_one()
+        return self.consecutive >= self.max_bad
+
+    def note_rollback(self) -> None:
+        """Record one rollback; raise once the budget is exhausted."""
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise DivergenceUnrecoverable(
+                f"training diverged again after {self.max_rollbacks} "
+                "rollback(s) to known-good checkpoints — a deterministic "
+                "divergence (bad data, runaway lr) that replaying cannot "
+                "fix; fix the config instead of rolling back forever")
+        self.reset()
+
+    def reset(self) -> None:
+        """Clear the consecutive counter and any queued observations (the
+        steps they belong to were discarded by a rollback)."""
+        self.consecutive = 0
+        self._queue.clear()

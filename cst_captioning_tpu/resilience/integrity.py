@@ -1,0 +1,164 @@
+"""Checkpoint integrity: per-step manifests, verify-on-restore, walk-back.
+
+Orbax's own commit is atomic against concurrent READERS (tmp dir + rename),
+but "the newest step directory exists" still does not prove the payload is
+whole: a power cut or SIGKILL can journal the rename without all data
+blocks, and a torn file only surfaces as an opaque deserialization error at
+the worst possible time — restore, inside an unattended resume loop.
+
+This module closes that gap with a content manifest written AFTER the orbax
+commit: ``<step_dir>/manifest.json`` lists every payload file with its size
+and SHA-256.  Restore-time verification then has three honest outcomes:
+
+- ``"verified"``   — manifest present, every file matches;
+- ``"corrupt"``    — manifest present, a file is missing/resized/altered,
+  OR the manifest itself is absent while the write marker says one was
+  started (the save was torn between commit and manifest);
+- ``"unverified"`` — no manifest and no marker: a checkpoint from before
+  this layer existed.  Accepted (legacy compatibility) with a log line.
+
+``CheckpointManager`` walks back to the newest non-corrupt step when the
+latest one fails verification, so the scale-chain's "auto-resume from
+newest" can never restore a torn state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, Optional, Tuple
+
+log = logging.getLogger("cst_captioning_tpu.resilience.integrity")
+
+MANIFEST_NAME = "manifest.json"
+#: Written (fsync'd) BEFORE hashing starts, removed only by the manifest's
+#: atomic replace: its presence without a manifest proves a torn save.
+_MARKER_NAME = ".manifest.writing"
+
+
+def manifest_path(step_dir: str) -> str:
+    return os.path.join(step_dir, MANIFEST_NAME)
+
+
+def _iter_payload_files(step_dir: str):
+    """Every regular file under ``step_dir`` except the manifest artifacts,
+    as (relpath, abspath), in sorted order for stable manifests."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            rel = os.path.relpath(os.path.join(root, name), step_dir)
+            if rel in (MANIFEST_NAME, _MARKER_NAME):
+                continue
+            out.append((rel, os.path.join(root, name)))
+    return sorted(out)
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_manifest(step_dir: str) -> Dict[str, Dict]:
+    """Checksum every payload file of a committed step and atomically write
+    the manifest.  Crash-ordering: the marker is fsync'd first, so a save
+    killed mid-hash leaves marker-without-manifest (= corrupt, walk back),
+    never a silently manifest-less "legacy" step."""
+    marker = os.path.join(step_dir, _MARKER_NAME)
+    with open(marker, "w") as f:
+        f.flush()
+        os.fsync(f.fileno())
+    tmp = manifest_path(step_dir) + ".tmp"
+    try:
+        files = {}
+        for rel, path in _iter_payload_files(step_dir):
+            files[rel] = {"bytes": os.path.getsize(path),
+                          "sha256": _sha256(path)}
+        manifest = {"version": 1, "files": files}
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        # A CLEAN failure (caught and handled by the caller) must remove
+        # the marker as well as the tmp file: the checkpoint itself is
+        # whole, and marker-without-manifest would otherwise read as
+        # "torn" and get a perfectly good step quarantined on the next
+        # start.  Only a hard crash mid-hash — where no cleanup can run —
+        # leaves the marker, which is exactly the case it exists for.
+        for leftover in (tmp, marker):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+        raise
+    os.replace(tmp, manifest_path(step_dir))
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    fsync_dir(step_dir)
+    return manifest
+
+
+def verify_step_dir(step_dir: str, level: str = "full") -> Tuple[str, str]:
+    """-> (status, detail) with status in {verified, corrupt, unverified}.
+
+    ``level="full"`` re-hashes every payload file against the manifest;
+    ``level="stat"`` stops at existence + byte sizes — sufficient for the
+    torn-write failure mode (truncation / missing files) at stat cost,
+    used by the startup quarantine scan so healthy multi-GB checkpoints
+    are not fully re-read on every manager construction.  Restore-time
+    verification always runs full."""
+    mpath = manifest_path(step_dir)
+    if not os.path.exists(mpath):
+        if os.path.exists(os.path.join(step_dir, _MARKER_NAME)):
+            return "corrupt", "manifest write was torn (marker present)"
+        return "unverified", "no manifest (pre-integrity-layer checkpoint)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError) as e:
+        return "corrupt", f"unreadable manifest: {e}"
+    on_disk = dict(_iter_payload_files(step_dir))
+    for rel, want in files.items():
+        path = on_disk.get(rel)
+        if path is None:
+            return "corrupt", f"missing file {rel!r}"
+        size = os.path.getsize(path)
+        if size != want["bytes"]:
+            return ("corrupt",
+                    f"{rel!r} is {size} bytes, manifest says {want['bytes']}")
+        if level == "full" and _sha256(path) != want["sha256"]:
+            return "corrupt", f"{rel!r} content checksum mismatch"
+    extra = set(on_disk) - set(files)
+    if extra:
+        # Extra files are tolerated (orbax may add metadata across
+        # versions) but surfaced — they are not covered by the checksum.
+        log.debug("step %s has %d file(s) outside its manifest: %s",
+                  step_dir, len(extra), sorted(extra)[:3])
+    return "verified", f"{len(files)} file(s) match"
+
+
+def fsync_dir(path: str) -> None:
+    """Persist directory-entry changes (renames, creates).  Best-effort:
+    some filesystems refuse O_RDONLY-fsync on directories; the data-file
+    fsyncs already happened, so a refusal only loses rename durability."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -13,10 +13,14 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import Any, Dict, Optional
+import shutil
+from typing import Any, Dict, Optional, Set, Tuple
 
 import jax
 import orbax.checkpoint as ocp
+
+from ..resilience import integrity
+from ..resilience.faults import FaultPlan
 
 log = logging.getLogger(__name__)
 
@@ -28,16 +32,50 @@ class CheckpointManager:
     ``<dir>/infos.json`` holding {"best_step", "best_score", "opts", ...}.
     The infos file is tiny and host-written — the reference's infos.pkl
     equivalent, readable without orbax.
+
+    Integrity layer (resilience/integrity.py): every committed step gets a
+    ``manifest.json`` of content checksums written AFTER the orbax commit;
+    restore verifies the manifest and walks back to the newest non-corrupt
+    step when the latest one is torn, so auto-resume never loads a
+    half-written state.  ``fault_plan`` arms the ``ckpt_torn`` chaos hook
+    (tear a payload file right after the manifest lands) — None in
+    production, zero overhead.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 2, keep_best: bool = True):
+    #: Torn step dirs are renamed aside with this suffix at startup.
+    QUARANTINE_SUFFIX = ".corrupt-quarantine"
+
+    def __init__(self, directory: str, max_to_keep: int = 2,
+                 keep_best: bool = True,
+                 fault_plan: Optional[FaultPlan] = None,
+                 readonly: bool = False):
+        """``readonly=True`` is for consumers that only restore (eval,
+        stage warm-start): it skips the destructive quarantine scan and
+        infos scrub, so a reader can never rename a step out from under
+        the trainer that owns the directory (e.g. during the owner's
+        post-commit manifest-hash window, when marker-without-manifest
+        legitimately exists for a moment).  Readers stay safe via
+        restore's full verification + walk-back."""
         self.directory = os.path.abspath(directory)
+        self._faults = fault_plan
+        self._verify_cache: Dict[tuple, Tuple[str, str]] = {}
         os.makedirs(self.directory, exist_ok=True)
+        # BEFORE orbax indexes anything: a step torn by a crash mid-save
+        # must be moved out of orbax's sight entirely.  Letting native
+        # code (tensorstore) parse a truncated ocdbt database is how a
+        # recovery run dies of heap corruption instead of resuming —
+        # observed in this environment as malloc "largebin corrupted"
+        # aborts on the resume-after-torn path.
+        self._quarantined: list = []
+        if not readonly:
+            self._quarantine_torn_steps()
         self._infos_path = os.path.join(self.directory, "infos.json")
         self.infos: Dict[str, Any] = {"best_step": None, "best_score": None}
         if os.path.exists(self._infos_path):
             with open(self._infos_path) as f:
                 self.infos = json.load(f)
+        if self._quarantined:
+            self._scrub_infos_after_quarantine()
 
         def best_fn(metrics: Dict[str, float]) -> float:
             return metrics.get("score", float("-inf"))
@@ -82,6 +120,7 @@ class CheckpointManager:
             mgr, metrics = self._recovery_mgr(), None
         else:
             mgr, metrics = self._mgr, {"score": float(score)}
+        self._clear_existing(mgr, step)
         # ``params`` is saved as its own entry so the next stage can
         # warm-start weights without matching this stage's optimizer
         # structure (XE -> WXE -> CST chaining, SURVEY.md §5).
@@ -94,6 +133,7 @@ class CheckpointManager:
             metrics=metrics,
         )
         mgr.wait_until_finished()
+        self._seal_step(step, recovery=score is None)
         if score is not None and (
             self.infos["best_score"] is None or score > self.infos["best_score"]
         ):
@@ -114,6 +154,9 @@ class CheckpointManager:
         if extra:
             self.infos.update(extra)
         self.infos["last_step"] = int(step)
+        self._write_infos()
+
+    def _write_infos(self) -> None:
         # Atomic replace: the wedge-recovery paths (watchdog os._exit,
         # harness SIGKILL) can land mid-write, and a truncated infos.json
         # would turn the NEXT resume into a json.load crash — the recovery
@@ -134,11 +177,70 @@ class CheckpointManager:
                 pass
             raise
         os.replace(tmp, self._infos_path)
+        # fsync the DIRECTORY too: the rename itself is a directory-entry
+        # update, and a power cut / SIGKILL can otherwise lose it even
+        # though the tmp file's data blocks were fsync'd above.
+        integrity.fsync_dir(self.directory)
+
+    def _scrub_infos_after_quarantine(self) -> None:
+        """A quarantined step's bookkeeping must go with it: leaving its
+        best_step/step_scores entries behind would let a REPLAYED (new,
+        different) state at the same step number inherit the torn
+        checkpoint's recorded score — e.g. restore(best=True) serving a
+        worse state under the old best's score.  Only MAIN-dir
+        quarantines count: scores belong to scored (main-manager) saves,
+        and a torn recovery twin of the same step number must not demote
+        an intact scored best."""
+        gone = {step for step, is_recovery in self._quarantined
+                if not is_recovery}
+        if not gone:
+            return
+        scores = {int(s): float(v)
+                  for s, v in self.infos.get("step_scores", {}).items()
+                  if int(s) not in gone}
+        if "step_scores" in self.infos:
+            self.infos["step_scores"] = {str(s): v
+                                         for s, v in scores.items()}
+        best = self.infos.get("best_step")
+        if best is not None and int(best) in gone:
+            new_best = self._best_retained(scores)
+            self.infos["best_step"] = new_best
+            self.infos["best_score"] = (None if new_best is None
+                                        else scores[new_best])
+            log.warning(
+                "best checkpoint (step %d) was quarantined as torn; best "
+                "bookkeeping now %s", int(best), new_best)
+        self._write_infos()
+
+    @staticmethod
+    def _best_retained(scores: Dict[int, float]) -> Optional[int]:
+        """Best step among retained scored steps: highest score, ties to
+        the smallest step — the ONE definition shared by restore's
+        trimmed-best fallback and the quarantine scrub."""
+        if not scores:
+            return None
+        return min(scores, key=lambda s: (-scores[s], s))
+
+    @staticmethod
+    def _clear_existing(mgr: ocp.CheckpointManager, step: int) -> None:
+        """A step being re-saved can already exist on disk: a divergence
+        rollback (or a resume that walked back past a torn newest step)
+        replays steps whose directories survive from the first pass.
+        Orbax refuses to save over them — delete first, loudly."""
+        if step in mgr.all_steps():
+            log.warning("overwriting existing checkpoint step %d "
+                        "(replay after rollback/walk-back)", step)
+            try:
+                mgr.delete(step)
+            except Exception as e:  # directory may be half-torn
+                log.warning("could not delete stale step %d cleanly: %s",
+                            step, e)
 
     def save_recovery(self, step: int, state) -> None:
         """Periodic crash-recovery save (``--save_every_steps``): keeps only
         the most recent one, never affects best-score bookkeeping."""
         mgr = self._recovery_mgr()
+        self._clear_existing(mgr, step)
         mgr.save(
             step,
             args=ocp.args.Composite(
@@ -147,6 +249,130 @@ class CheckpointManager:
             ),
         )
         mgr.wait_until_finished()
+        self._seal_step(step, recovery=True)
+
+    # -- integrity ---------------------------------------------------------
+
+    def _quarantine_torn_steps(self) -> None:
+        """Rename every integrity-corrupt step dir to ``<step>.corrupt-
+        quarantine`` (kept for forensics, invisible to orbax's digit-dir
+        scan).  The scan runs at STAT level (marker / existence / sizes,
+        no hashing) so read-only consumers (eval, warm-start) don't pay a
+        full re-read of healthy multi-GB checkpoints at startup; restore
+        still full-verifies the step it actually loads.  Steps without a
+        manifest pass as legacy; the walk-back in ``_resolve_step`` covers
+        tears that happen AFTER this manager was constructed (e.g. the
+        ckpt_torn chaos hook)."""
+        for base in (self.directory, os.path.join(self.directory, "recovery")):
+            if not os.path.isdir(base):
+                continue
+            for name in sorted(os.listdir(base)):
+                if not name.isdigit():
+                    continue
+                step_dir = os.path.join(base, name)
+                if not os.path.isdir(step_dir):
+                    continue
+                status, detail = integrity.verify_step_dir(step_dir,
+                                                           level="stat")
+                if status != "corrupt":
+                    continue
+                dst = step_dir + self.QUARANTINE_SUFFIX
+                try:
+                    shutil.rmtree(dst, ignore_errors=True)
+                    os.rename(step_dir, dst)
+                except OSError as e:
+                    log.warning("could not quarantine torn step %s: %s",
+                                step_dir, e)
+                    continue
+                self._quarantined.append(
+                    (int(name), base != self.directory))
+                log.warning(
+                    "quarantined torn checkpoint step %s (%s) -> %s; "
+                    "resume will use the newest verified step", name,
+                    detail, os.path.basename(dst))
+
+    def _step_dir(self, step: int, recovery: Optional[bool] = None) -> str:
+        """On-disk directory of a committed step.  ``recovery`` pins the
+        manager (save paths KNOW which one they wrote — the same step
+        number can exist in both); None resolves main-first, mirroring
+        ``_mgr_for``'s restore preference."""
+        rec = os.path.join(self.directory, "recovery", str(step))
+        if recovery is True:
+            return rec
+        main = os.path.join(self.directory, str(step))
+        if recovery is False or os.path.isdir(main):
+            return main
+        return rec
+
+    def _seal_step(self, step: int, recovery: bool) -> None:
+        """Post-commit manifest write + the ``ckpt_torn`` chaos hook, on
+        the directory the saving manager actually wrote.  A manifest
+        failure is logged, not raised — the checkpoint itself is committed
+        and an unverified step still restores (legacy rule)."""
+        step_dir = self._step_dir(step, recovery=recovery)
+        try:
+            integrity.write_manifest(step_dir)
+        except OSError as e:
+            log.warning("could not write integrity manifest for step %d: %s",
+                        step, e)
+            return
+        if self._faults is not None and self._faults.fire("ckpt_torn", step):
+            self._tear_step(step_dir)
+
+    @staticmethod
+    def _tear_step(step_dir: str) -> None:
+        """Chaos: truncate the largest payload file to half its size —
+        the torn-write shape a power cut produces, which the manifest
+        (already written, listing the full size) must catch on restore."""
+        files = [(os.path.getsize(p), p)
+                 for _rel, p in integrity._iter_payload_files(step_dir)]
+        if not files:
+            return
+        size, victim = max(files)
+        with open(victim, "r+b") as f:
+            f.truncate(max(0, size // 2))
+        log.warning("FAULT: tore checkpoint file %s (%d -> %d bytes)",
+                    victim, size, max(0, size // 2))
+
+    def _verify_dir(self, step_dir: str) -> Tuple[str, str]:
+        """``integrity.verify_step_dir`` behind a cache: resume touches
+        the same steps through quarantine, latest_verified_step, and
+        restore's resolution, and re-hashing a multi-GB checkpoint three
+        times would triple recovery latency.  The key is the manifest
+        mtime PLUS a stat signature (relpath, size, mtime) of every
+        payload file — a stat walk costs microseconds against the hash's
+        full read, and any truncation/rewrite (including the chaos tear
+        hook, which edits payload bytes without touching the manifest)
+        changes the key and forces a fresh hash.  Manifest-less dirs are
+        not cached (cheap to recompute, nothing stable to key on)."""
+        try:
+            mkey = os.stat(integrity.manifest_path(step_dir)).st_mtime_ns
+            sig = tuple(
+                (rel, os.stat(path).st_size, os.stat(path).st_mtime_ns)
+                for rel, path in integrity._iter_payload_files(step_dir))
+        except OSError:
+            return integrity.verify_step_dir(step_dir)
+        key = (step_dir, mkey, sig)
+        hit = self._verify_cache.get(key)
+        if hit is None:
+            hit = integrity.verify_step_dir(step_dir)
+            self._verify_cache[key] = hit
+        return hit
+
+    def verify_step(self, step: int) -> Tuple[str, str]:
+        """-> (status, detail): 'verified' / 'unverified' (pre-manifest
+        legacy) / 'corrupt'."""
+        return self._verify_dir(self._step_dir(step))
+
+    @property
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step that passes integrity verification (legacy
+        manifest-less steps count as passing) — what auto-resume should
+        restore.  None when no intact checkpoint exists."""
+        for step in sorted(self._available_steps(), reverse=True):
+            if self.verify_step(step)[0] != "corrupt":
+                return step
+        return None
 
     # -- restore -----------------------------------------------------------
 
@@ -174,36 +400,71 @@ class CheckpointManager:
             steps |= set(self._recovery_mgr().all_steps())
         return steps
 
+    def _pick_step(self, best: bool, excluded: Set[int]) -> Optional[int]:
+        """One resolution pass over the steps not yet ruled out."""
+        avail = self._available_steps() - excluded
+        if not avail:
+            return None
+        if best and self.best_step is not None:
+            if self.best_step in avail:
+                return self.best_step
+            # The recorded best step's DATA was trimmed (orbax keeps the
+            # top-k by score with ties broken arbitrarily, while best_step
+            # records the FIRST of tied scores, strict >) — or it failed
+            # verification.  Equal score == equal quality — restore the
+            # best step that was retained (smallest step among the top
+            # scores).
+            scores = {int(s): float(v) for s, v in
+                      self.infos.get("step_scores", {}).items()
+                      if int(s) in avail}
+            step = self._best_retained(scores)
+            if step is not None:
+                log.warning(
+                    "best step %d is unavailable (trimmed by retention or "
+                    "failed verification); restoring best retained step %d "
+                    "(score %s)", self.best_step, step, scores[step])
+                return step
+        return max(avail)
+
     def _resolve_step(self, step: Optional[int], best: bool) -> int:
-        if step is None:
-            # A stage trained without a val split never records scores, so
-            # best_step stays None — fall back to the latest checkpoint
-            # rather than failing stage chaining / eval.
-            step = (self.best_step if best and self.best_step is not None
-                    else self.latest_step)
-            avail = (self._available_steps()
-                     if best and step is not None else ())
-            if best and step is not None and step not in avail:
-                # The recorded best step's DATA was trimmed: orbax keeps
-                # the top-k by score with ties broken arbitrarily, while
-                # best_step records the FIRST of tied scores (strict >).
-                # Equal score == equal quality — restore the best step
-                # that was retained (smallest step among the top scores).
-                scores = {int(s): v for s, v in
-                          self.infos.get("step_scores", {}).items()
-                          if int(s) in avail}
-                if scores:
-                    trimmed = step
-                    step = min(scores, key=lambda s: (-scores[s], s))
+        if step is not None:
+            # An EXPLICITLY requested step never silently substitutes: a
+            # torn step the caller named is an error, not a walk-back.
+            status, detail = self.verify_step(step)
+            if status == "corrupt":
+                raise ValueError(
+                    f"checkpoint step {step} in {self.directory} failed "
+                    f"integrity verification ({detail}); refusing to "
+                    "restore a torn state")
+            return step
+        # Auto-resolution (latest / best): verify the candidate and walk
+        # back past torn steps so the newest INTACT state is restored —
+        # a stage trained without a val split never records scores, so
+        # best_step stays None and we fall back to the latest checkpoint
+        # rather than failing stage chaining / eval.
+        excluded: Set[int] = set()
+        while True:
+            cand = self._pick_step(best, excluded)
+            if cand is None:
+                if excluded:
+                    raise FileNotFoundError(
+                        f"every checkpoint in {self.directory} failed "
+                        f"integrity verification ({sorted(excluded)}); "
+                        "no intact state to restore")
+                raise FileNotFoundError(f"no checkpoint in {self.directory}")
+            status, detail = self.verify_step(cand)
+            if status != "corrupt":
+                if status == "unverified":
+                    log.info("restoring step %d without a manifest "
+                             "(pre-integrity-layer checkpoint)", cand)
+                if excluded:
                     log.warning(
-                        "best step %d was trimmed by checkpoint retention; "
-                        "restoring best retained step %d (score %s)",
-                        trimmed, step, scores[step])
-                else:
-                    step = self.latest_step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return step
+                        "walked back past torn checkpoint step(s) %s to "
+                        "verified step %d", sorted(excluded), cand)
+                return cand
+            log.warning("checkpoint step %d failed integrity verification "
+                        "(%s); walking back", cand, detail)
+            excluded.add(cand)
 
     def _mgr_for(self, step: int) -> ocp.CheckpointManager:
         if step in self._mgr.all_steps():

@@ -102,3 +102,14 @@ class RewardPipeline:
             state, done = self._complete_one(state)
             completed.append(done)
         return state, completed
+
+    def abort(self) -> int:
+        """Discard every in-flight rollout WITHOUT completing its grad step;
+        returns how many were dropped.  Used by the divergence-guard
+        rollback: pending rollouts were drawn from the diverged params, and
+        grading them against the restored checkpoint would apply stale,
+        possibly non-finite updates to the very state the rollback just
+        recovered."""
+        dropped = len(self._pending)
+        self._pending.clear()
+        return dropped

@@ -28,12 +28,48 @@ def _grad_norm(grads) -> jnp.ndarray:
     return optax.global_norm(grads)
 
 
-def make_xe_step(model, seq_per_img: int) -> Callable:
+def _apply_gradients_guarded(state: TrainState, grads, loss,
+                             guard: bool):
+    """Optimizer update with the divergence guard's device half folded in.
+
+    ``guard=False`` is today's exact behavior.  With ``guard=True`` the
+    step checks ``isfinite(loss) & isfinite(global_grad_norm)`` ON DEVICE
+    and, when the check fails, masks the parameter AND optimizer-state
+    update back to their pre-step values — the step becomes a counted
+    no-op (``state.step`` still advances, keeping resume/log accounting
+    monotonic) and ``metrics['bad_step']`` reports 1.0.  No host sync is
+    added: the flag travels with the other metrics and the host guard
+    (resilience/guard.py) fetches it with a lag.  On a good step the
+    ``where`` selects the new leaves exactly, so guarded and unguarded
+    trajectories are bit-identical.
+    """
+    gnorm = _grad_norm(grads)
+    new_state = state.apply_gradients(grads=grads)
+    metrics = {"loss": loss, "grad_norm": gnorm}
+    if guard:
+        ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+        def sel(new, old):
+            return jnp.where(ok, new, old)
+
+        new_state = new_state.replace(
+            params=jax.tree_util.tree_map(sel, new_state.params,
+                                          state.params),
+            opt_state=jax.tree_util.tree_map(sel, new_state.opt_state,
+                                             state.opt_state),
+        )
+        metrics["bad_step"] = 1.0 - ok.astype(jnp.float32)
+    return new_state, metrics
+
+
+def make_xe_step(model, seq_per_img: int, guard: bool = False) -> Callable:
     """(state, feats, labels, weights, rng) -> (state, metrics).
 
     ``weights`` = per-caption consensus weights: all-ones reproduces plain
     XE; consensus softmax weights give the WXE stage.  One compiled step
-    serves both stages (weights are data, not structure).
+    serves both stages (weights are data, not structure).  ``guard=True``
+    folds the divergence guard's finite-check/skip into the program
+    (``_apply_gradients_guarded``).
     """
 
     def step(state: TrainState, feats, labels, weights, rng):
@@ -47,9 +83,7 @@ def make_xe_step(model, seq_per_img: int) -> Callable:
             return cross_entropy_loss(logits, labels, weights)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        new_state = state.apply_gradients(grads=grads)
-        metrics = {"loss": loss, "grad_norm": _grad_norm(grads)}
-        return new_state, metrics
+        return _apply_gradients_guarded(state, grads, loss, guard)
 
     return step
 
@@ -128,6 +162,7 @@ def make_fused_cst_step(
     temperature: float = 1.0,
     scb_gt_baseline=None,      # (V,) f32 per-video baseline for scb-gt
     ref_chunk: int | None = None,
+    guard: bool = False,
 ) -> Callable:
     """(state, feats, video_ix, rng) -> (state, metrics): the ENTIRE CST
     iteration as ONE device program — rollout, on-device CIDEr-D rewards
@@ -193,21 +228,20 @@ def make_fused_cst_step(
             return reward_loss(logp, sampled, advantage)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        new_state = state.apply_gradients(grads=grads)
-        metrics = {
-            "loss": loss,
-            "grad_norm": _grad_norm(grads),
+        new_state, metrics = _apply_gradients_guarded(state, grads, loss,
+                                                      guard)
+        metrics.update({
             "sample_len": sequence_mask(sampled).sum(axis=1).mean(),
             "reward": r_sample.mean(),
             "baseline": r_base.mean(),
             "advantage": advantage.mean(),
-        }
+        })
         return new_state, metrics
 
     return step
 
 
-def make_rl_grad_step(model, seq_per_img: int) -> Callable:
+def make_rl_grad_step(model, seq_per_img: int, guard: bool = False) -> Callable:
     """(state, feats, sampled, advantage, rng) -> (state, metrics).
 
     REINFORCE gradient: recompute log-probs of the sampled sequences under
@@ -235,12 +269,9 @@ def make_rl_grad_step(model, seq_per_img: int) -> Callable:
             return reward_loss(logp, sampled, advantage)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        new_state = state.apply_gradients(grads=grads)
-        metrics = {
-            "loss": loss,
-            "grad_norm": _grad_norm(grads),
-            "sample_len": sequence_mask(sampled).sum(axis=1).mean(),
-        }
+        new_state, metrics = _apply_gradients_guarded(state, grads, loss,
+                                                      guard)
+        metrics["sample_len"] = sequence_mask(sampled).sum(axis=1).mean()
         return new_state, metrics
 
     return step

@@ -47,6 +47,8 @@ from ..opts import (
 )
 from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
+from ..resilience.faults import FaultPlan
+from ..resilience.guard import DivergenceGuard
 from ..utils.watchdog import ProgressWatchdog
 from .checkpoint import CheckpointManager
 from .evaluation import eval_split
@@ -189,8 +191,46 @@ class Trainer:
                 f"--eval_metric {opt.eval_metric!r} is not one of "
                 f"{self.KNOWN_EVAL_METRICS}"
             )
+        # Chaos fault plan (resilience/faults.py): parsed ONCE here and
+        # threaded explicitly into every component that hosts an injection
+        # point (loader, checkpoint manager, this loop) — no module-global
+        # arming, so parallel Trainers can never leak faults into each
+        # other.  None (the production case) costs one is-None check per
+        # hook, all host-side, nothing inside jit.
+        self._faults = FaultPlan.parse(
+            getattr(opt, "fault_plan", None)
+            or os.environ.get("CST_FAULT_PLAN"))
+        if self._faults is not None:
+            # Persist firings next to the checkpoints: process-killing
+            # faults (wedge) stay single-shot across the resume attempts a
+            # recovery harness (scale_chain) spawns for this stage dir.
+            os.makedirs(opt.checkpoint_path, exist_ok=True)
+            self._faults.bind_state(os.path.join(
+                os.path.abspath(opt.checkpoint_path),
+                "fault_plan_state.jsonl"))
+            log.warning("FAULT INJECTION ARMED: %s — this run will break "
+                        "itself on purpose (chaos testing)", self._faults)
+        # Divergence guard: device-side finite-check + skip is folded into
+        # the compiled steps (steps._apply_gradients_guarded); this is the
+        # host half that counts consecutive bad steps and rolls back.
+        # Mutually exclusive with --debug_nans, which CRASHES on the first
+        # NaN and therefore preempts skip-and-rollback entirely.
+        guard_on = bool(getattr(opt, "divergence_guard", 1))
         if getattr(opt, "debug_nans", 0):
             jax.config.update("jax_debug_nans", True)
+            if guard_on:
+                log.warning(
+                    "--debug_nans and --divergence_guard are mutually "
+                    "exclusive: jax_debug_nans raises on the FIRST "
+                    "non-finite value, so the guard's skip-and-rollback "
+                    "can never run.  Disabling the divergence guard for "
+                    "this run (pass --divergence_guard 0 to silence).")
+                guard_on = False
+        self._guard = DivergenceGuard(
+            max_bad=getattr(opt, "divergence_max_bad", 3),
+            max_rollbacks=getattr(opt, "divergence_max_rollbacks", 2),
+        ) if guard_on else None
+        self._rng_salt = 0  # bumped per rollback: re-seeds the rollout keys
         self.rng = jax.random.PRNGKey(opt.seed)
 
         # -- data ----------------------------------------------------------
@@ -231,6 +271,7 @@ class Trainer:
             # train steps gather them by video_ix INSIDE jit, so per-batch
             # h5 feature reads and host->device feature transfers disappear.
             include_feats=not bool(getattr(opt, "device_feats", 0)),
+            fault_plan=self._faults,
         )
         self.val_loader = (
             CaptionLoader(
@@ -280,7 +321,9 @@ class Trainer:
         # checkpoint (fresh optimizer state), like the reference's
         # --start_from (SURVEY.md §5 checkpoint/resume).
         if getattr(opt, "start_from", None):
-            prev = CheckpointManager(opt.start_from)
+            # readonly: a reader must never quarantine/scrub a directory
+            # another stage owns (checkpoint.py __init__ docstring).
+            prev = CheckpointManager(opt.start_from, readonly=True)
             params = prev.restore_params(self.state.params, best=True)
             self.state = self.state.replace(params=params)
             prev.close()
@@ -288,11 +331,37 @@ class Trainer:
                      opt.start_from, prev.best_step)
 
         self.ckpt = CheckpointManager(opt.checkpoint_path,
-                                      max_to_keep=opt.max_checkpoints)
-        if self.ckpt.latest_step is not None:
-            self.state = self.ckpt.restore(self.state)
+                                      max_to_keep=opt.max_checkpoints,
+                                      fault_plan=self._faults)
+        resume_step = self.ckpt.latest_verified_step
+        if resume_step is not None:
+            latest = self.ckpt.latest_step
+            if latest is not None and latest != resume_step:
+                log.warning(
+                    "newest checkpoint (step %d) failed integrity "
+                    "verification — torn write; resuming from the last "
+                    "verified step %d instead", latest, resume_step)
+            self.state = self.ckpt.restore(self.state, step=resume_step)
             log.info("resumed from step %d in %s", int(self.state.step),
                      opt.checkpoint_path)
+        elif self.ckpt.latest_step is not None:
+            log.warning(
+                "every checkpoint in %s failed integrity verification; "
+                "starting this stage from scratch", opt.checkpoint_path)
+        # Divergence-rollback target: a HOST-memory snapshot of the last
+        # known-good state, refreshed at every checkpoint save (and here,
+        # right after a resume — a fresh run deliberately has NO snapshot
+        # until its first save, so an early divergence continues forward
+        # from the skip-protected current state instead of replaying from
+        # step 0).  Rolling back from memory instead of re-reading the
+        # checkpoint keeps the recovery path free of same-process
+        # tensorstore reads — observed to corrupt the heap on this
+        # session's CPU stack — and costs no tunnel round trip on a remote
+        # device.  The disk checkpoint remains the cross-process resume
+        # source.
+        self._good_state = None
+        if resume_step is not None:
+            self._snapshot_good_state(resume_step)
 
         # -- device-resident features (--device_feats) ---------------------
         self._feat_tables = None
@@ -300,7 +369,8 @@ class Trainer:
             self._feat_tables = self._load_device_feats()
 
         # -- compiled steps ------------------------------------------------
-        xe_raw = make_xe_step(self.model, opt.seq_per_img)
+        xe_raw = make_xe_step(self.model, opt.seq_per_img,
+                              guard=self._guard is not None)
         if self._feat_tables is not None:
             tables = self._feat_tables
 
@@ -312,6 +382,8 @@ class Trainer:
             xe_raw, self.mesh, batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
         self.reward_computer = None
+        self._rl_pipeline = None
+        self._fused_step = None
         if opt.use_rl:
             self._setup_rl()
         self._watchdog.beat()  # init milestones (uploads, RL tables) done
@@ -547,7 +619,8 @@ class Trainer:
             self.model, opt.max_length, opt.seq_per_img,
             temperature=opt.temperature,
             greedy_baseline=opt.rl_baseline == "greedy")
-        rl_raw = make_rl_grad_step(self.model, opt.seq_per_img)
+        rl_raw = make_rl_grad_step(self.model, opt.seq_per_img,
+                                   guard=self._guard is not None)
         if self._feat_tables is not None:
             tables = self._feat_tables
 
@@ -662,6 +735,7 @@ class Trainer:
             self.model, opt.max_length, opt.seq_per_img, corpus, tables,
             baseline=opt.rl_baseline, temperature=opt.temperature,
             scb_gt_baseline=scb_gt, ref_chunk=ref_chunk,
+            guard=self._guard is not None,
         )
         if self._feat_tables is not None:
             feat_tables = self._feat_tables
@@ -692,10 +766,41 @@ class Trainer:
             return np.asarray(batch.video_ix, dtype=np.int32)
         return batch.feats
 
+    def _rollout_rng(self, step_ix: int):
+        """Rollout key for one dispatch step.  ``_rng_salt`` is 0 until the
+        first divergence rollback; each rollback bumps it so the replayed
+        steps draw a FRESH key stream — replaying the exact multinomial
+        draws that just diverged would re-walk the same trajectory."""
+        base = self.rng
+        if self._rng_salt:
+            base = jax.random.fold_in(base, 1_000_003 + self._rng_salt)
+        return jax.random.fold_in(base, step_ix)
+
+    def _nan_fault_inputs(self, step_ix: int, arrays):
+        """``nan_grad`` chaos hook: when the plan covers ``step_ix``,
+        replace the step's host-side input arrays with all-NaN twins of
+        the same shape/dtype so the device computes a non-finite
+        loss/gradient — exercising the guard without touching the compiled
+        program.  Returns the arrays unchanged (same objects) otherwise."""
+        if self._faults is None or not self._faults.fire("nan_grad", step_ix):
+            return arrays
+        log.warning("FAULT: nan_grad at step %d — feeding NaN inputs",
+                    step_ix + 1)
+        return [np.full(np.shape(a), np.nan, dtype=np.asarray(a).dtype)
+                for a in arrays]
+
+    def _observe_guard(self, step_ix: int, metrics) -> None:
+        if self._guard is not None:
+            self._guard.observe(step_ix, metrics.get("bad_step"))
+
     def _xe_iteration(self, batch) -> Dict[str, float]:
+        # XE's NaN injection point is the consensus-weight vector: always
+        # host-resident, multiplies straight into the loss on any path.
+        (weights,) = self._nan_fault_inputs(self._progress_step,
+                                            [batch.weights])
         self.state, metrics = self.xe_step(
             self.state, self._batch_feats_arg(batch), batch.labels,
-            batch.weights, self.rng
+            weights, self.rng
         )
         return metrics
 
@@ -708,20 +813,32 @@ class Trainer:
         Returns the steps COMPLETED by this call as (step_index, metrics)
         pairs — empty while the pipeline fills.
         """
-        roll_rng = jax.random.fold_in(self.rng, self._rl_dispatch_step)
         step_ix = self._rl_dispatch_step
+        roll_rng = self._rollout_rng(step_ix)
         self._rl_dispatch_step += 1
+        # RL's NaN injection point is the streamed feature arrays (NaN
+        # features -> NaN logits -> NaN loss/grads).  With --device_feats
+        # the features never cross the host, so the hook cannot reach them:
+        # fail the chaos drill loudly instead of silently not injecting.
+        feats_arg = self._batch_feats_arg(batch)
+        if self._faults is not None and self._faults.pending("nan_grad"):
+            if self._feat_tables is not None:
+                raise RuntimeError(
+                    "nan_grad fault injection needs host-streamed features "
+                    "on RL paths; rerun the chaos drill with "
+                    "--device_feats 0")
+            feats_arg = self._nan_fault_inputs(step_ix, feats_arg)
         if self._fused_step is not None:  # --device_rewards: no host gap
             if self._feat_tables is not None:
                 self.state, metrics = self._fused_step(
-                    self.state, self._batch_feats_arg(batch), roll_rng)
+                    self.state, feats_arg, roll_rng)
             else:
                 self.state, metrics = self._fused_step(
-                    self.state, batch.feats,
+                    self.state, feats_arg,
                     np.asarray(batch.video_ix, dtype=np.int32), roll_rng)
             return [(step_ix, metrics)]
         self.state, completed = self._rl_pipeline.push(
-            self.state, self._batch_feats_arg(batch), roll_rng, self.rng,
+            self.state, feats_arg, roll_rng, self.rng,
             (step_ix, batch.video_ids),
         )
         return [(c[0], m) for c, m in completed]
@@ -733,6 +850,70 @@ class Trainer:
             return []
         self.state, completed = self._rl_pipeline.drain(self.state)
         return [(c[0], m) for c, m in completed]
+
+    def _snapshot_good_state(self, step: int) -> None:
+        """Host-memory copy of the current state — the divergence guard's
+        rollback target.  Called right after every checkpoint save (the
+        state just proven durable) and after a resume.  ``step`` is the
+        HOST-side step counter the snapshot belongs to: the rollback's
+        loop/key bookkeeping is rebuilt from it rather than from a device
+        scalar fetch (which this environment's native stack occasionally
+        garbles — RESILIENCE.md caveat).  No-op when the guard is off:
+        the snapshot's device->host fetch would be pure overhead."""
+        if self._guard is None:
+            return
+        self._good_state = (int(step),
+                            jax.tree_util.tree_map(np.asarray, self.state))
+
+    def _handle_divergence(self, failed_step: int) -> Optional[int]:
+        """Rollback after ``--divergence_max_bad`` consecutive non-finite
+        steps: reload the last known-good state (host snapshot taken at
+        the last checkpoint save), discard in-flight rollouts, re-seed the
+        rollout key stream, and return the loop step to replay from —
+        or None when there is nothing to rewind to, meaning "finish the
+        current iteration normally" (so an epoch-boundary validate/save is
+        not silently skipped).  ``DivergenceUnrecoverable`` propagates
+        once the ``--divergence_max_rollbacks`` budget is spent — a
+        divergence that replaying cannot fix must abort, not loop
+        forever."""
+        self._guard.note_rollback()
+        if self._rl_pipeline is not None:
+            dropped = self._rl_pipeline.abort()
+            if dropped:
+                log.warning("divergence rollback: discarded %d in-flight "
+                            "rollout(s) drawn from the diverged params",
+                            dropped)
+        self._rng_salt += 1
+        if self._good_state is None:
+            # No checkpoint this run — but the guard's on-device skips kept
+            # params at their last finite values, so the CURRENT state is
+            # the known-good state: continue forward on a fresh key stream
+            # instead of dying before the first checkpoint.
+            log.warning(
+                "divergence guard: rollback requested at step %d but no "
+                "checkpoint exists yet; continuing from the current "
+                "(skip-protected) state with re-seeded rollout keys "
+                "(salt %d)", failed_step + 1, self._rng_salt)
+            self._rl_dispatch_step = failed_step + 1
+            return None
+        import jax.numpy as jnp
+
+        good_step, snap = self._good_state
+        state = jax.tree_util.tree_map(jnp.asarray, snap)
+        # Pin the step from the host counter: the snapshot's own step leaf
+        # is authoritative too, but rebuilding the loop position from a
+        # plain python int keeps this recovery path free of device-scalar
+        # round trips.
+        self.state = state.replace(
+            step=jnp.asarray(good_step, dtype=state.step.dtype))
+        self._rl_dispatch_step = good_step
+        log.warning(
+            "divergence guard: rolled back from step %d to the known-good "
+            "state of step %d (rollback %d/%d); replaying with a "
+            "re-seeded rollout key stream (salt %d)",
+            failed_step + 1, good_step, self._guard.rollbacks,
+            self._guard.max_rollbacks, self._rng_salt)
+        return good_step
 
     # -- main loop ---------------------------------------------------------
 
@@ -765,8 +946,11 @@ class Trainer:
     def train(self) -> Dict[str, Any]:
         opt = self.opt
         bpe = self.loader.batches_per_epoch
+        # The loader itself (not iter(loader)) so the prefetch worker can
+        # re-issue a failed next_batch: transient feature-read errors are
+        # retried with backoff instead of poisoning the run.
         it = iter(prefetch_to_device(
-            iter(self.loader), size=2,
+            self.loader, size=2,
             device_put=lambda x: jax.device_put(x, self._batch_sharding),
             feat_dtype=self._feat_dtype(),
         ))
@@ -798,14 +982,23 @@ class Trainer:
 
         def drain_and_log():
             for k, m in self._rl_drain():
+                self._observe_guard(k, m)
                 self._maybe_log_train(k + 1, m, total_steps, bpe)
 
         profiling = False
-        for step in range(start_step, total_steps):
+        step = start_step
+        # while (not for): a divergence rollback rewinds ``step`` to the
+        # restored checkpoint and replays from there.
+        while step < total_steps:
             # Each completed loop pass implies the previous dispatch, fetch,
             # val, and save all returned — one beat covers them all.
             self._watchdog.beat()
             self._progress_step = step  # host int, safe for describe()
+            if self._faults is not None and self._faults.fire("wedge", step):
+                log.critical("FAULT: wedge at step %d — blocking the train "
+                             "loop (the watchdog must turn this into exit "
+                             "%s)", step + 1, "124")
+                time.sleep(2 ** 31)
             if opt.profile_dir:
                 if step == opt.profile_start and not profiling:
                     jax.profiler.start_trace(opt.profile_dir)
@@ -820,11 +1013,17 @@ class Trainer:
                 # Completed steps lag dispatch by the pipeline depth; each
                 # is logged under ITS OWN step index, not the loop's.
                 for k, m in self._rl_iteration(batch):
+                    self._observe_guard(k, m)
                     self._maybe_log_train(k + 1, m, total_steps, bpe)
             else:
-                self._maybe_log_train(
-                    step + 1, self._xe_iteration(batch), total_steps, bpe
-                )
+                metrics = self._xe_iteration(batch)
+                self._observe_guard(step, metrics)
+                self._maybe_log_train(step + 1, metrics, total_steps, bpe)
+            if self._guard is not None and self._guard.poll():
+                rewind = self._handle_divergence(step)
+                if rewind is not None:
+                    step = rewind
+                    continue
 
             if (opt.save_every_steps
                     and (step + 1) % opt.save_every_steps == 0
@@ -832,10 +1031,19 @@ class Trainer:
                 if opt.use_rl:
                     drain_and_log()  # checkpoint must include all updates
                 self.ckpt.save_recovery(step + 1, self.state)
+                self._snapshot_good_state(step + 1)
 
             if (step + 1) % bpe == 0:  # epoch boundary
                 if opt.use_rl:
                     drain_and_log()  # validate/ckpt on fully-updated params
+                # Reap every queued bad-step flag before validating/saving:
+                # a divergence in the epoch's tail must roll back, not ride
+                # into the best-score bookkeeping.
+                if self._guard is not None and self._guard.flush():
+                    rewind = self._handle_divergence(step)
+                    if rewind is not None:
+                        step = rewind
+                        continue
                 scores = self.validate()
                 if scores is not None:
                     metric = scores.get(score_key(opt.eval_metric), 0.0)
@@ -855,6 +1063,7 @@ class Trainer:
                                    extra={"opt": vars(opt),
                                           "val_scores": scores,
                                           "patience": patience})
+                    self._snapshot_good_state(step + 1)
                     self._watchdog.beat()  # orbax fetch+write completed
                     # min_epochs floors the STOP, not the patience count:
                     # epochs without improvement keep accumulating, but
@@ -867,9 +1076,18 @@ class Trainer:
                         break
                 else:
                     self.ckpt.save(step + 1, self.state)
+                    self._snapshot_good_state(step + 1)
+            step += 1
 
         if opt.use_rl:
             drain_and_log()  # no-op unless the run ended mid-pipeline
+        if self._guard is not None:
+            self._guard.flush()  # surface any trailing skipped steps
+            if self._guard.total_skipped:
+                log.warning(
+                    "divergence guard summary: %d step(s) skipped as "
+                    "non-finite, %d rollback(s)",
+                    self._guard.total_skipped, self._guard.rollbacks)
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
         return {

@@ -41,7 +41,9 @@ def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
     the user explicitly passed on this command line (``cli_explicit``) —
     an explicit ``--max_length`` must not be silently overridden by the
     training-time value."""
-    ckpt = CheckpointManager(checkpoint_path)
+    # readonly: eval must never quarantine/scrub a training run's live
+    # directory (torn steps are skipped by restore's verification anyway).
+    ckpt = CheckpointManager(checkpoint_path, readonly=True)
     saved = ckpt.infos.get("opt")
     if saved:
         opt = argparse.Namespace(**{**vars(cli_opt), **{

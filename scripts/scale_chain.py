@@ -426,6 +426,13 @@ def main() -> int:
                    help="harness-side hard cap per eval invocation, a "
                         "second safety net over eval's own in-process "
                         "--wedge_timeout watchdog; 0 = none")
+    p.add_argument("--fault_plan", default=None,
+                   help="CHAOS DRILL: forward this fault plan (see "
+                        "RESILIENCE.md grammar) to every TRAIN stage — "
+                        "e.g. 'wedge@step=70' proves the whole "
+                        "wedge->probe->resume loop end to end.  Faults "
+                        "fire once per stage run; the harness must ride "
+                        "them out exactly like real failures")
     args = p.parse_args()
     # Stages run as subprocesses with cwd=REPO; a relative --out_dir must
     # mean the same directory in the harness and in every stage.
@@ -468,6 +475,8 @@ def main() -> int:
         "--seed", str(args.seed),
         "--wedge_timeout", str(args.wedge_timeout),
     ]
+    if args.fault_plan:
+        common += ["--fault_plan", args.fault_plan]
     xe_sched = [
         "--max_patience", str(args.patience),
         "--learning_rate_decay_every", str(args.lr_decay_every),

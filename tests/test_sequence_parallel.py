@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from cst_captioning_tpu.parallel.mesh import make_mesh
 from cst_captioning_tpu.parallel.sequence import (
     ring_cross_attention,
+    shard_map,
     sp_additive_attention,
     sp_cross_attention_jit,
     sp_dot_attention,
@@ -91,7 +92,7 @@ def test_sp_additive_matches_module_math(mesh):
     w = w / w.sum(-1, keepdims=True)
     want = np.einsum("bt,bth->bh", w, mem)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         lambda qp, m, p, v: sp_additive_attention(
             qp, m, p, v, axis_name="model"),
         mesh=mesh,
@@ -113,7 +114,7 @@ def test_multihead_wrapper_matches_per_head_reference(mesh):
         for h in range(nh)
     ], axis=2)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         lambda q, k, v: sp_multihead_cross_attention(
             q, k, v, axis_name="model"),
         mesh=mesh,

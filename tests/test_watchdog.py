@@ -100,6 +100,30 @@ class TestProgressWatchdog:
             wd.stop()
         assert len(fired) >= 2, f"watchdog fired {len(fired)}x, wanted >=2"
 
+    def test_rearm_measures_fresh_gap(self):
+        """The rearm path (watchdog.py _run: beat() after a returning
+        handler) must reset the reference point: every firing after the
+        first reports a gap measured from the PREVIOUS firing, not an
+        ever-growing gap since the last real beat.  Without the rearm the
+        second gap would be ~2x the first and grow each poll."""
+        fired = []
+        wd = ProgressWatchdog(0.2, on_timeout=lambda g: fired.append(g))
+        wd.start()
+        try:
+            deadline = time.time() + 10.0
+            while len(fired) < 3 and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert len(fired) >= 3
+        # poll interval is max(1.0, timeout/4) = 1.0s, so a FRESH gap is
+        # bounded by timeout + ~one poll (plus slop); a cumulative gap
+        # would exceed 2x that bound by the third firing.
+        for i, gap in enumerate(fired[:3]):
+            assert gap < 2.5, (
+                f"firing {i} measured gap {gap:.2f}s — heartbeat was not "
+                "rearmed after the handler returned")
+
 
 # Driver for the trainer-wiring test: a real Trainer on a tiny fixture
 # whose validate() wedges forever — the armed --wedge_timeout must kill
