@@ -31,8 +31,8 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo scale_chain \
-        report collect chip_window clean
+.PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo trace-demo \
+        scale_chain report collect chip_window clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -115,6 +115,12 @@ bench:
 
 demo:
 	$(PY) scripts/demo.py --out_dir /tmp/cst_demo
+
+# Telemetry demo (OBSERVABILITY.md): short CPU train with --trace_dir,
+# then the scripts/trace_report.py per-phase table.  Artifacts land in
+# /tmp/cst_trace_demo (Chrome traces, metrics.jsonl, telemetry.json).
+trace-demo:
+	JAX_PLATFORMS=cpu $(PY) scripts/trace_demo.py --out_dir /tmp/cst_trace_demo
 
 # MSR-VTT-scale synthetic chain (640 videos x 20 captions, ~8k vocab,
 # ResNet+C3D shapes): XE-to-convergence -> WXE -> CST (fused rewards) ->

@@ -44,82 +44,26 @@ import time
 
 import numpy as np
 
-BASELINE_CAPTIONS_PER_SEC = 5000.0
-
-#: bf16 peak matmul TFLOP/s per chip by device_kind substring (first match
-#: wins; jax device_kind strings look like "TPU v5 lite").  Public numbers
-#: from the TPU generations' spec sheets; used only to turn achieved
-#: TFLOP/s into an MFU percentage.
-PEAK_BF16_TFLOPS = (
-    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
-    ("v6 lite", 918.0), ("v6e", 918.0),
-    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+# FLOPs/MFU accounting is shared with the trainer's live mfu_pct gauge
+# (cst_captioning_tpu/telemetry/flops.py — pure math, no jax import, so
+# the probe-before-backend ordering below is preserved).  These names
+# stay re-exported here for bench's existing callers/tests.
+from cst_captioning_tpu.telemetry.flops import (  # noqa: F401
+    PEAK_BF16_TFLOPS,
+    caption_step_flops,
+    mfu_fields,
+    peak_tflops,
 )
 
-
-def peak_tflops(device_kind: str) -> float | None:
-    kind = (device_kind or "").lower()
-    for sub, peak in PEAK_BF16_TFLOPS:
-        if sub in kind:
-            return peak
-    return None
+BASELINE_CAPTIONS_PER_SEC = 5000.0
 
 
 def analytic_step_flops(args) -> dict:
-    """Analytic matmul FLOPs of one optimizer step, from the config alone.
-
-    Counts the MXU work the architecture performs (encoder projections,
-    memory projection, per-step attention, LSTM gates, vocab head) at
-    2 FLOPs/MAC, with backward ≈ 2x forward — the standard "model FLOPs"
-    convention, so the derived MFU excludes remat recompute and the
-    device CIDEr-D's integer hashing (both make real utilization slightly
-    higher than reported).  Shapes mirror build(): ResNet-152 (28, 2048) +
-    C3D (1, 4096) features, embed = attn = hidden.
-
-    CST counts the shipped fused step: sampled + greedy rollouts (forward
-    only, one shared encode) plus the REINFORCE gradient step (fwd+bwd)
-    over the sampled captions.
-    """
-    B, S, L = args.batch_size, args.seq_per_img, args.seq_len
-    N = B * S
-    H = A = args.hidden
-    V = args.vocab
-    feat = [(28, 2048), (1, 4096)]
-    T = sum(t for t, _ in feat)
-    enc = B * sum(t * d * H for t, d in feat)   # per-modality Dense
-    enc += B * (len(feat) * H) * H              # fuse Dense
-    enc += B * T * H * A                        # memory_proj (attention)
-    enc += B * H * 2 * H                        # state_init
-    # One decoder step for one caption: attention query proj + additive
-    # scores + context, LSTM gates on concat(embed, context) -> (3H x 4H),
-    # and the hoisted vocab head.
-    per_step = H * A + T * A + T * H + 3 * H * 4 * H + H * V
-    dec = N * L * per_step
-    fwd = enc + dec
-    xe = 3 * fwd * 2.0                          # fwd + 2x bwd, 2 FLOPs/MAC
-    # The greedy-baseline rollout decodes ONE row per image (B rows, not
-    # B*S — steps.py make_rollout_fused returns greedy (B, L)).
-    greedy_dec = B * L * per_step
-    cst = (enc + dec + greedy_dec) * 2.0 + xe
-    return {"xe": xe, "cst": cst}
-
-
-def mfu_fields(flops_per_step: float, captions_per_sec: float | None,
-               ncaps: int, device_kind: str | None) -> dict:
-    """captions/s -> {model_tflops_per_step, achieved_tflops, mfu_pct}.
-
-    mfu_pct is None off-TPU (no meaningful peak for the host CPU) and on
-    unrecognized device kinds."""
-    if not captions_per_sec:
-        return {}
-    achieved = flops_per_step * captions_per_sec / ncaps / 1e12
-    peak = peak_tflops(device_kind or "")
-    sig = lambda x: float(f"{x:.4g}")  # keep tiny-shape runs nonzero
-    return {
-        "model_tflops_per_step": sig(flops_per_step / 1e12),
-        "achieved_tflops": sig(achieved),
-        "mfu_pct": None if peak is None else sig(100.0 * achieved / peak),
-    }
+    """Analytic step FLOPs at this run's CLI shapes — the MSR-VTT bench
+    feature geometry (telemetry.flops.DEFAULT_FEAT_SHAPES) mirroring
+    build().  -> {"xe": F, "cst": F}."""
+    return caption_step_flops(args.batch_size, args.seq_per_img,
+                              args.seq_len, args.vocab, args.hidden)
 
 
 def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
@@ -520,7 +464,20 @@ def run_measurement(args) -> None:
         "unit": "captions/s/chip",
         "platform": platform,
         "num_devices": jax.device_count(),
+        # Landed on the host CPU while a device was WANTED (probe failed /
+        # device child died) — explicit, instead of implied by "platform".
+        "cpu_fallback": (platform == "cpu"
+                         and os.environ.get("_BENCH_CPU_FALLBACK") == "1"),
     }
+    # Backend-probe telemetry from the parent (attempt latencies, timeout
+    # count — satellite of ISSUE 2): the parent probes, the child
+    # measures, so the record crosses via env.
+    probe_json = os.environ.get("_BENCH_PROBE_JSON")
+    if probe_json:
+        try:
+            common["probe"] = json.loads(probe_json)
+        except ValueError:
+            pass
     if args.stage == "xe":
         xe = bench_xe(args)
         _emit({
@@ -568,12 +525,16 @@ def run_measurement(args) -> None:
     }, args)
 
 
-def probe_backend(timeout_s: float, retries: int) -> str | None:
+def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
     """Initialize the default jax backend in a throwaway subprocess.
 
-    Returns its platform string, or None if every attempt failed or timed
-    out — a downed remote-TPU tunnel blocks *inside* backend init, so the
-    probe (not the measurement) is what must absorb the hang.
+    Returns ``(platform, probe_info)``: the platform string (None if every
+    attempt failed or timed out — a downed remote-TPU tunnel blocks
+    *inside* backend init, so the probe, not the measurement, is what must
+    absorb the hang) plus a telemetry record of every attempt.
+    ``probe_info`` rides into the emitted JSON so three silent 120s
+    timeouts (BENCH_r05) become an auditable
+    ``{"attempts": [...], "timeouts": 3}`` instead of stderr-only noise.
 
     The probe child runs in its own process group with output to temp
     files, not pipes: a wedged PJRT plugin can spawn helper processes that
@@ -584,8 +545,18 @@ def probe_backend(timeout_s: float, retries: int) -> str | None:
     import signal
     import tempfile
 
+    info: dict = {"attempts": [], "timeouts": 0, "timeout_s": timeout_s}
+
+    def done(outcome: str, t0: float, platform: str | None = None):
+        rec = {"outcome": outcome,
+               "latency_s": round(time.perf_counter() - t0, 3)}
+        if platform is not None:
+            rec["platform"] = platform
+        info["attempts"].append(rec)
+
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     for attempt in range(retries + 1):
+        t0 = time.perf_counter()
         with tempfile.TemporaryFile("w+") as out, \
                 tempfile.TemporaryFile("w+") as err:
             proc = subprocess.Popen(
@@ -600,21 +571,27 @@ def probe_backend(timeout_s: float, retries: int) -> str | None:
                 except OSError:
                     proc.kill()
                 proc.wait()
+                done("timeout", t0)
+                info["timeouts"] += 1
                 print(f"bench: backend probe timed out ({timeout_s:.0f}s), "
                       f"attempt {attempt + 1}/{retries + 1}", file=sys.stderr)
                 continue
             out.seek(0)
             for line in out.read().splitlines():
                 if line.startswith("PLATFORM="):
-                    return line.split("=", 1)[1].strip()
+                    plat = line.split("=", 1)[1].strip()
+                    done("ok", t0, plat)
+                    return plat, info
             err.seek(0)
+            done("error", t0)
             print(f"bench: backend probe rc={proc.returncode}, attempt "
                   f"{attempt + 1}/{retries + 1}\n{err.read()[-2000:]}",
                   file=sys.stderr)
-    return None
+    return None, info
 
 
-def spawn_child(scrub: bool, timeout_s: float) -> tuple[int, bool]:
+def spawn_child(scrub: bool, timeout_s: float,
+                extra_env: dict | None = None) -> tuple[int, bool]:
     """Re-exec this script for the measurement; returns (rc, emitted).
 
     Runs in its own process group (see run_in_group) so that if the device
@@ -632,6 +609,8 @@ def spawn_child(scrub: bool, timeout_s: float) -> tuple[int, bool]:
 
     env = dict(os.environ)
     env["_BENCH_CHILD"] = "1"
+    if extra_env:
+        env.update(extra_env)
     if scrub:
         scrub_env(env)
         env["PYTHONPATH"] = ""  # drop any sitecustomize (e.g. .axon_site)
@@ -665,7 +644,8 @@ HEADLINE_METRIC = {
 }
 
 
-def last_resort_emit(args, child_rc: int, reason: str) -> None:
+def last_resort_emit(args, child_rc: int, reason: str,
+                     probe: dict | None = None) -> None:
     """Final line of defense for the one-JSON-line contract: every exit
     path of main() must print exactly one parseable line, even when the
     device backend is wedged AND the CPU-fallback child itself died or
@@ -686,6 +666,8 @@ def last_resort_emit(args, child_rc: int, reason: str) -> None:
         "child_rc": child_rc,
         "error": reason,
     }
+    if probe is not None:
+        result["probe"] = probe
     entry = read_cache_entry(metric)
     if entry is not None:
         result["last_tpu_result"] = entry
@@ -707,23 +689,37 @@ def main():
         return
 
     use_device = False
+    probe_info = None
+    cpu_fallback = False
     if args.platform in ("auto", "device"):
-        plat = probe_backend(args.probe_timeout, args.probe_retries)
+        plat, probe_info = probe_backend(args.probe_timeout,
+                                         args.probe_retries)
         if plat is not None and plat != "cpu":
             use_device = True
         elif args.platform == "device":
             last_resort_emit(args, -1, "--platform device but the default "
                              f"backend is {plat!r} after "
-                             f"{args.probe_retries + 1} probes")
+                             f"{args.probe_retries + 1} probes",
+                             probe=probe_info)
             sys.exit(1)
         elif plat == "cpu":
             print("bench: default backend is the host CPU; measuring there",
                   file=sys.stderr)
         else:
+            cpu_fallback = True  # device wanted, probe never answered
             print("bench: default backend unreachable, falling back to host "
-                  "CPU (JSON will say platform=cpu)", file=sys.stderr)
+                  "CPU (JSON will say platform=cpu, cpu_fallback=true)",
+                  file=sys.stderr)
 
-    rc, emitted = spawn_child(scrub=not use_device, timeout_s=args.child_timeout)
+    def child_env(fallback: bool) -> dict:
+        env = {"_BENCH_CPU_FALLBACK": "1" if fallback else "0"}
+        if probe_info is not None:
+            env["_BENCH_PROBE_JSON"] = json.dumps(probe_info)
+        return env
+
+    rc, emitted = spawn_child(scrub=not use_device,
+                              timeout_s=args.child_timeout,
+                              extra_env=child_env(cpu_fallback))
     if rc != 0 and not emitted and use_device and args.platform == "auto":
         # Device path died mid-measurement (tunnel dropped?) before printing
         # its JSON line — still emit a well-formed line rather than nothing.
@@ -731,7 +727,8 @@ def main():
         # re-run: two JSON lines would break the one-line contract.)
         print("bench: device measurement failed, retrying on host CPU",
               file=sys.stderr)
-        rc, emitted = spawn_child(scrub=True, timeout_s=args.child_timeout)
+        rc, emitted = spawn_child(scrub=True, timeout_s=args.child_timeout,
+                                  extra_env=child_env(True))
     if not emitted:
         # The last measurement child died or timed out without printing —
         # the one case round 3 shipped without cover.  Emit the degraded
@@ -742,7 +739,8 @@ def main():
         last_resort_emit(
             args, rc,
             "measurement child produced no JSON "
-            + ("(timed out)" if rc == 124 else f"(rc={rc})"))
+            + ("(timed out)" if rc == 124 else f"(rc={rc})"),
+            probe=probe_info)
         sys.exit(0 if args.platform == "auto" else 1)
     sys.exit(rc)
 

@@ -27,6 +27,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..resilience.faults import FaultPlan, InjectedFault
+from ..telemetry.spans import NULL_SPAN
 from .dataset import CaptionDataset
 
 log = logging.getLogger("cst_captioning_tpu.loader")
@@ -198,7 +199,8 @@ class CaptionLoader:
 def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
                        size: int = 2, device_put=None, feat_dtype=None,
                        retries: int = 3,
-                       retry_backoff_s: float = 0.05) -> Iterator[Batch]:
+                       retry_backoff_s: float = 0.05,
+                       telemetry=None) -> Iterator[Batch]:
     """Run batch assembly (h5 reads, numpy packing) in a background thread,
     optionally applying ``device_put`` (e.g. a sharding-aware jax.device_put)
     to feats/labels/weights before handing the batch to the consumer.
@@ -226,10 +228,18 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
     Worker lifetime: abandoning the iterator (break / GeneratorExit) wakes
     the worker via the ``closed`` event and JOINS it, so no thread — and no
     prefetched HBM buffer it holds — outlives the consumer.
+
+    ``telemetry`` (a ``telemetry.Telemetry``, optional): retry attempts
+    count into the ``loader_retries`` counter, and when span tracing is
+    armed the worker records ``prefetch_assemble`` (h5 reads + numpy
+    packing) and ``prefetch_device_put`` spans on its own trace row — the
+    overlap of batch t+1's IO under step t's compute becomes visible in
+    the Chrome trace.  None = one is-None check per batch.
     """
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = object()
     closed = threading.Event()  # consumer gone: worker must drop its buffers
+    tracer = telemetry.tracer if telemetry is not None else None
 
     next_batch = getattr(batches, "next_batch", None)
     if next_batch is None:
@@ -253,6 +263,8 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
             except TRANSIENT_ERRORS as e:
                 if attempt >= retries or closed.is_set():
                     raise
+                if telemetry is not None:
+                    telemetry.inc("loader_retries")
                 log.warning(
                     "transient batch-read error (%s); retry %d/%d in %.2fs",
                     e, attempt + 1, retries, delay)
@@ -272,7 +284,11 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
     def work():
         try:
             while not closed.is_set():
-                b = produce_with_retry()
+                if tracer is None:
+                    b = produce_with_retry()
+                else:
+                    with tracer.span("prefetch_assemble"):
+                        b = produce_with_retry()
                 if b is None:  # finite source exhausted
                     break
                 if feat_dtype is not None:
@@ -282,14 +298,17 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
                         video_ids=b.video_ids, gts=b.gts, video_ix=b.video_ix,
                     )
                 if device_put is not None:
-                    b = Batch(
-                        feats=[device_put(f) for f in b.feats],
-                        labels=device_put(b.labels),
-                        weights=device_put(b.weights),
-                        video_ids=b.video_ids,
-                        gts=b.gts,
-                        video_ix=b.video_ix,
-                    )
+                    put_span = (NULL_SPAN if tracer is None
+                                else tracer.span("prefetch_device_put"))
+                    with put_span:
+                        b = Batch(
+                            feats=[device_put(f) for f in b.feats],
+                            labels=device_put(b.labels),
+                            weights=device_put(b.weights),
+                            video_ids=b.video_ids,
+                            gts=b.gts,
+                            video_ix=b.video_ix,
+                        )
                 if not _put(b):
                     return
         except Exception as e:  # propagate into the consumer thread

@@ -259,8 +259,23 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
                         "steps into this directory (view with TensorBoard)")
     g.add_argument("--profile_start", type=int, default=10,
                    help="step at which the profiler trace starts")
-    g.add_argument("--profile_steps", type=int, default=10,
-                   help="number of steps to trace")
+    g.add_argument("--profile_steps", default="10",
+                   help="either a step COUNT (trace --profile_start ..+N, "
+                        "the historical form) or an explicit 'A:B' window "
+                        "tracing steps A..B-1 (ignores --profile_start)")
+    g.add_argument("--trace_dir", default=None,
+                   help="write host-side span traces (data_wait/compute/"
+                        "score/ckpt + loader prefetch + checkpoint commit) "
+                        "to this directory as Chrome-trace JSON — load in "
+                        "Perfetto or chrome://tracing (OBSERVABILITY.md).  "
+                        "Implies --step_timing.  Unset = every span hook "
+                        "disarmed at one is-None check")
+    g.add_argument("--step_timing", type=int, default=None,
+                   help="1 = per-log-interval step-phase gauges "
+                        "(data_wait_ms/compute_ms/score_ms/ckpt_ms) and "
+                        "live mfu_pct in metrics.jsonl, without span "
+                        "tracing.  Default: on when --trace_dir is set, "
+                        "else off (zero per-step overhead)")
     g.add_argument("--debug_nans", type=int, default=0,
                    help="1 = jax_debug_nans (crash on the FIRST NaN with a "
                         "traceback; debugging mode).  Mutually exclusive "

@@ -96,6 +96,14 @@ class FaultPlan:
     specs: List[FaultSpec]
     _consumed: Set[Tuple[str, int]] = field(default_factory=set)
     _state_path: Optional[str] = None
+    _metrics: Optional[object] = field(default=None, repr=False)
+
+    def bind_metrics(self, registry) -> "FaultPlan":
+        """Count firings into a ``telemetry.MetricsRegistry``
+        (``fault_firings`` total + ``fault_<kind>`` per kind) so a chaos
+        drill's injections are auditable in the exit telemetry.json."""
+        self._metrics = registry
+        return self
 
     def bind_state(self, path: str) -> "FaultPlan":
         """Persist consumed firings to ``path`` (JSONL, append-only) and
@@ -161,6 +169,9 @@ class FaultPlan:
                             os.fsync(f.fileno())
                     except OSError:
                         pass
+                if self._metrics is not None:
+                    self._metrics.inc("fault_firings")
+                    self._metrics.inc(f"fault_{kind}")
                 log.warning("FAULT INJECTED: %s fired at %s=%d (spec %s)",
                             kind, KINDS[kind], index, spec)
                 return True

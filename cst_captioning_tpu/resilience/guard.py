@@ -32,13 +32,21 @@ class DivergenceUnrecoverable(RuntimeError):
 
 
 class DivergenceGuard:
-    """Counts consecutive non-finite steps; decides skip vs rollback."""
+    """Counts consecutive non-finite steps; decides skip vs rollback.
+
+    ``metrics`` (a ``telemetry.MetricsRegistry``, optional) receives the
+    audit counters — ``divergence_guard_trips`` per non-finite step and
+    ``divergence_guard_rollbacks`` per rollback — so a chaos drill's
+    outcome is machine-readable in the exit telemetry.json instead of
+    only greppable from stderr.  None costs one is-None check per event
+    (and events are rare by construction)."""
 
     def __init__(self, max_bad: int = 3, max_rollbacks: int = 2,
-                 lag: int = 1):
+                 lag: int = 1, metrics=None):
         self.max_bad = max(1, int(max_bad))
         self.max_rollbacks = max(0, int(max_rollbacks))
         self.lag = max(0, int(lag))
+        self._metrics = metrics
         self._queue: Deque[Tuple[int, object]] = deque()
         self.consecutive = 0
         self.total_skipped = 0
@@ -63,6 +71,8 @@ class DivergenceGuard:
             self.consecutive += 1
             self.total_skipped += 1
             self.last_bad_step = step_ix
+            if self._metrics is not None:
+                self._metrics.inc("divergence_guard_trips")
             log.warning(
                 "divergence guard: non-finite loss/grad at step %d — update "
                 "skipped on device (%d consecutive, %d total)",
@@ -88,6 +98,8 @@ class DivergenceGuard:
     def note_rollback(self) -> None:
         """Record one rollback; raise once the budget is exhausted."""
         self.rollbacks += 1
+        if self._metrics is not None:
+            self._metrics.inc("divergence_guard_rollbacks")
         if self.rollbacks > self.max_rollbacks:
             raise DivergenceUnrecoverable(
                 f"training diverged again after {self.max_rollbacks} "

@@ -1,0 +1,126 @@
+"""Unified telemetry: span tracing, metrics registry, step phases, MFU.
+
+One bundle (:class:`Telemetry`) threads through the components that host
+an instrumentation point — trainer loop, loader prefetch, reward scoring,
+checkpoint manager, resilience machinery — exactly the way ``FaultPlan``
+threads: explicitly, no module globals, and a disabled instrument costs
+its call site one is-None check (``OBSERVABILITY.md`` has the taxonomy
+and overhead notes).
+
+Pieces:
+
+- :mod:`.spans`    — host-side span tracer, Chrome-trace JSON export
+  (``--trace_dir``; view in Perfetto / chrome://tracing).
+- :mod:`.registry` — counters/gauges/histograms with sink fan-out to
+  metrics.jsonl (schema 2), TensorBoard, and a ``telemetry.json`` exit
+  snapshot.
+- :mod:`.phases`   — per-log-interval step-phase gauges
+  (``data_wait_ms``/``compute_ms``/``score_ms``/``ckpt_ms``).
+- :mod:`.flops`    — analytic model FLOPs + MFU (shared with bench.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .flops import caption_step_flops, mfu_fields, peak_tflops
+from .phases import STEP_PHASES, StepPhases
+from .registry import (
+    METRICS_SCHEMA,
+    JsonlSink,
+    MetricsRegistry,
+    ScalarWriterSink,
+)
+from .spans import NULL_SPAN, SpanTracer, trace_span
+
+__all__ = [
+    "METRICS_SCHEMA", "NULL_SPAN", "STEP_PHASES",
+    "JsonlSink", "MetricsRegistry", "ScalarWriterSink", "SpanTracer",
+    "StepPhases", "Telemetry",
+    "caption_step_flops", "mfu_fields", "peak_tflops", "trace_span",
+]
+
+
+class Telemetry:
+    """Registry (always) + optional tracer + optional phase timer.
+
+    ``registry`` always exists — counters are how rare resilience events
+    (rollbacks, quarantines, retries) become auditable, and they cost
+    nothing per step.  ``tracer``/``phases`` stay None unless the
+    telemetry flags enable them; hot-loop call sites hold the attribute
+    in a local and branch on is-None (the ``--fault_plan`` pattern), so
+    an un-instrumented run allocates nothing per step.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[SpanTracer] = None,
+                 phases: Optional[StepPhases] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.tracer = tracer
+        self.phases = phases
+        self.snapshot_path: Optional[str] = None
+        self._closed = False
+
+    @classmethod
+    def from_opts(cls, opt) -> "Telemetry":
+        """Build from the CLI namespace: ``--trace_dir`` arms the span
+        tracer, ``--step_timing`` (auto-on under --trace_dir) arms the
+        phase gauges.  Sinks are attached later by the owner, once it
+        knows whether this process is the pod's metrics writer."""
+        tracer = None
+        trace_dir = getattr(opt, "trace_dir", None)
+        if trace_dir:
+            tracer = SpanTracer(trace_dir)
+        phases = None
+        step_timing = getattr(opt, "step_timing", None)
+        if step_timing is None:
+            step_timing = tracer is not None
+        if int(step_timing) or tracer is not None:
+            phases = StepPhases(tracer)
+        return cls(tracer=tracer, phases=phases)
+
+    # -- convenience hooks -------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Tracer span, or the shared no-op when tracing is off."""
+        tracer = self.tracer
+        if tracer is None:
+            return NULL_SPAN
+        return tracer.span(name, **args)
+
+    def phase(self, name: str):
+        """Phase-timed (and traced) interval; no-op when both are off."""
+        phases = self.phases
+        if phases is not None:
+            return phases.phase(name)
+        tracer = self.tracer
+        if tracer is not None:
+            return tracer.span(name)
+        return NULL_SPAN
+
+    def inc(self, name: str, n: float = 1) -> None:
+        self.registry.inc(name, n)
+
+    def flush(self, fsync: bool = False) -> None:
+        self.registry.flush(fsync=fsync)
+        if self.tracer is not None and fsync:
+            self.tracer.flush()
+
+    def close(self, snapshot_path: Optional[str] = None) -> None:
+        """Idempotent: flush sinks, write the exit telemetry.json (when a
+        path was configured), close the tracer.  Safe from atexit."""
+        if self._closed:
+            return
+        self._closed = True
+        path = snapshot_path or self.snapshot_path
+        if path:
+            try:
+                os.makedirs(os.path.dirname(os.path.abspath(path)),
+                            exist_ok=True)
+                self.registry.write_snapshot(path)
+            except OSError:
+                pass  # the snapshot is evidence, never a crash source
+        self.registry.close()
+        if self.tracer is not None:
+            self.tracer.close()

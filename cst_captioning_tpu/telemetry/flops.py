@@ -1,0 +1,100 @@
+"""Analytic model-FLOPs accounting + MFU derivation — ONE implementation.
+
+Moved out of the root ``bench.py`` so the trainer's live ``mfu_pct`` gauge
+and the benchmark's offline MFU report share the same arithmetic and can
+never drift (bench.py re-exports these names for its callers).  Pure
+Python/math — deliberately importable without jax, because bench's parent
+process must not initialize a backend before its probe does.
+
+Counts the MXU work the architecture performs (encoder projections,
+memory projection, per-step attention, LSTM gates, vocab head) at
+2 FLOPs/MAC, with backward ≈ 2x forward — the standard "model FLOPs"
+convention, so the derived MFU excludes remat recompute and the device
+CIDEr-D's integer hashing (both make real utilization slightly higher
+than reported).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+#: bf16 peak matmul TFLOP/s per chip by device_kind substring (first match
+#: wins; jax device_kind strings look like "TPU v5 lite").  Public numbers
+#: from the TPU generations' spec sheets; used only to turn achieved
+#: TFLOP/s into an MFU percentage.
+PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+)
+
+#: The MSR-VTT bench shapes (ResNet-152 + C3D) — bench.py's default.
+DEFAULT_FEAT_SHAPES: Tuple[Tuple[int, int], ...] = ((28, 2048), (1, 4096))
+
+
+def peak_tflops(device_kind: str) -> Optional[float]:
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def caption_step_flops(
+    batch_size: int,
+    seq_per_img: int,
+    seq_len: int,
+    vocab: int,
+    hidden: int,
+    feat_shapes: Sequence[Tuple[int, int]] = DEFAULT_FEAT_SHAPES,
+) -> Dict[str, float]:
+    """Analytic matmul FLOPs of one optimizer step -> {"xe": F, "cst": F}.
+
+    Shapes mirror the attention-LSTM captioner with embed = attn = hidden
+    (the shipped default; runs with distinct --input_encoding_size/
+    --att_size read this as an estimate, which is all MFU needs).
+
+    CST counts the shipped fused step: sampled + greedy rollouts (forward
+    only, one shared encode) plus the REINFORCE gradient step (fwd+bwd)
+    over the sampled captions.
+    """
+    B, S, L = batch_size, seq_per_img, seq_len
+    N = B * S
+    H = A = hidden
+    V = vocab
+    feat = list(feat_shapes)
+    T = sum(t for t, _ in feat)
+    enc = B * sum(t * d * H for t, d in feat)   # per-modality Dense
+    enc += B * (len(feat) * H) * H              # fuse Dense
+    enc += B * T * H * A                        # memory_proj (attention)
+    enc += B * H * 2 * H                        # state_init
+    # One decoder step for one caption: attention query proj + additive
+    # scores + context, LSTM gates on concat(embed, context) -> (3H x 4H),
+    # and the hoisted vocab head.
+    per_step = H * A + T * A + T * H + 3 * H * 4 * H + H * V
+    dec = N * L * per_step
+    fwd = enc + dec
+    xe = 3 * fwd * 2.0                          # fwd + 2x bwd, 2 FLOPs/MAC
+    # The greedy-baseline rollout decodes ONE row per image (B rows, not
+    # B*S — steps.py make_rollout_fused returns greedy (B, L)).
+    greedy_dec = B * L * per_step
+    cst = (enc + dec + greedy_dec) * 2.0 + xe
+    return {"xe": xe, "cst": cst}
+
+
+def mfu_fields(flops_per_step: float, captions_per_sec: Optional[float],
+               ncaps: int, device_kind: Optional[str]) -> dict:
+    """captions/s -> {model_tflops_per_step, achieved_tflops, mfu_pct}.
+
+    mfu_pct is None off-TPU (no meaningful peak for the host CPU) and on
+    unrecognized device kinds."""
+    if not captions_per_sec:
+        return {}
+    achieved = flops_per_step * captions_per_sec / ncaps / 1e12
+    peak = peak_tflops(device_kind or "")
+    sig = lambda x: float(f"{x:.4g}")  # keep tiny-shape runs nonzero
+    return {
+        "model_tflops_per_step": sig(flops_per_step / 1e12),
+        "achieved_tflops": sig(achieved),
+        "mfu_pct": None if peak is None else sig(100.0 * achieved / peak),
+    }

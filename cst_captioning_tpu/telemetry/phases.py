@@ -1,0 +1,82 @@
+"""Step-phase accumulator: per-log-interval data_wait/compute/score/ckpt.
+
+The trainer wraps each phase of its loop in ``phases.phase(name)``; at
+every ``--log_every`` interval the accumulated totals drain into the
+metrics stream as per-step ``<phase>_ms`` gauges.  Attribution is
+EXCLUSIVE: a phase opened inside another (host-path CST scores inside the
+step completion, so ``score`` nests under ``compute``) has its time
+subtracted from the parent, so the gauges partition wall-time instead of
+double-counting — the span trace keeps the full nested durations.
+
+Main-thread only by design (the trainer's loop is single-threaded; the
+prefetch worker reports through tracer spans + registry counters, not
+phases), so the nesting stack needs no locking.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .spans import SpanTracer
+
+#: The canonical step phases, in loop order.  drain() always emits all of
+#: them so the metrics.jsonl contract is stable even for phases a given
+#: configuration never enters (e.g. score under --device_rewards 1).
+STEP_PHASES = ("data_wait", "compute", "score", "ckpt")
+
+
+class _PhaseCtx:
+    __slots__ = ("_phases", "_name", "_span", "_t0", "_child")
+
+    def __init__(self, phases: "StepPhases", name: str):
+        self._phases = phases
+        self._name = name
+        tracer = phases._tracer
+        self._span = tracer.span(name) if tracer is not None else None
+
+    def __enter__(self) -> "_PhaseCtx":
+        if self._span is not None:
+            self._span.__enter__()
+        self._child = 0.0
+        self._phases._stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dur = time.perf_counter() - self._t0
+        if self._span is not None:
+            self._span.__exit__(*exc)
+        ph = self._phases
+        ph._stack.pop()
+        ph._totals[self._name] = (
+            ph._totals.get(self._name, 0.0) + dur - self._child)
+        if ph._stack:
+            ph._stack[-1]._child += dur
+        return False
+
+
+class StepPhases:
+    """Accumulates exclusive per-phase seconds; drains to *_ms gauges."""
+
+    def __init__(self, tracer: Optional[SpanTracer] = None):
+        self._tracer = tracer
+        self._totals: Dict[str, float] = {}
+        self._stack: List[_PhaseCtx] = []
+
+    def phase(self, name: str) -> _PhaseCtx:
+        return _PhaseCtx(self, name)
+
+    def drain_ms(self, steps: int) -> Dict[str, float]:
+        """-> {"<phase>_ms": mean exclusive ms per step} over the interval
+        since the last drain; resets the accumulator.  Every canonical
+        phase is always present (0.0 when never entered)."""
+        n = max(1, int(steps))
+        out = {f"{name}_ms": round(
+                   self._totals.get(name, 0.0) / n * 1e3, 3)
+               for name in STEP_PHASES}
+        for name in self._totals:
+            if name not in STEP_PHASES:  # ad-hoc phases still surface
+                out[f"{name}_ms"] = round(self._totals[name] / n * 1e3, 3)
+        self._totals = {}
+        return out

@@ -1,0 +1,206 @@
+"""Metrics registry: named counters/gauges/histograms with sink fan-out.
+
+Replaces the trainer's ad-hoc metrics-dict writes with ONE instrument
+surface that fans each step record out to every attached sink —
+``metrics.jsonl`` (schema-versioned, fsync-able at checkpoint boundaries),
+the TensorBoard ``ScalarWriter``, and a machine-readable ``telemetry.json``
+snapshot written on exit.  Counters are the resilience audit trail: a
+chaos drill's divergence trips, quarantines, fault firings, and loader
+retries all land here instead of vanishing into stderr.
+
+Threading: counters/gauges may be touched from worker threads (loader
+prefetch retries) and read from the watchdog thread (heartbeat payload);
+every mutation holds one small lock.  ``log_step`` is main-thread (the
+trainer's logging cadence), but locks anyway — correctness over the ~µs.
+
+Schema: every ``metrics.jsonl`` record and the ``telemetry.json`` snapshot
+carry ``"schema": 2`` so downstream readers (scripts/chain_report.py,
+scripts/collect_evidence.py) can evolve against a stable contract.
+Schema 1 is the implicit pre-telemetry format (no schema field).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: Version stamped into every metrics.jsonl record and telemetry snapshot.
+METRICS_SCHEMA = 2
+
+
+class MetricsRegistry:
+    """Counters, gauges, histograms + step-record fan-out to sinks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Dict[str, float]] = {}
+        self._sinks: List[Any] = []
+        self._last_train: Optional[Dict[str, Any]] = None
+        self._last_val: Optional[Dict[str, Any]] = None
+
+    # -- instruments -------------------------------------------------------
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Histogram-style observation: count/sum/min/max summary (enough
+        for latency audits without an unbounded reservoir)."""
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                self._hists[name] = {"count": 1, "sum": v, "min": v, "max": v}
+            else:
+                h["count"] += 1
+                h["sum"] += v
+                h["min"] = min(h["min"], v)
+                h["max"] = max(h["max"], v)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # -- step records ------------------------------------------------------
+
+    def add_sink(self, sink) -> None:
+        """A sink implements log_step(step, scope, metrics, wall_time),
+        flush(fsync=False), close()."""
+        self._sinks.append(sink)
+
+    def log_step(self, step: int, scope: str,
+                 metrics: Dict[str, Any]) -> None:
+        """Fan one step's metrics out to every sink and remember the last
+        record per scope (heartbeat + exit snapshot)."""
+        now = time.time()
+        with self._lock:
+            rec = {"step": int(step), "scope": scope, **metrics}
+            if scope == "val":
+                self._last_val = rec
+            else:
+                self._last_train = rec
+        for sink in self._sinks:
+            sink.log_step(step, scope, metrics, now)
+
+    def flush(self, fsync: bool = False) -> None:
+        for sink in self._sinks:
+            sink.flush(fsync=fsync)
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            hists = {
+                name: {**h, "mean": h["sum"] / max(h["count"], 1)}
+                for name, h in self._hists.items()
+            }
+            return {
+                "schema": METRICS_SCHEMA,
+                "time": time.time(),
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": hists,
+                "last_train": self._last_train,
+                "last_val": self._last_val,
+            }
+
+    def heartbeat_payload(self) -> Dict[str, Any]:
+        """Small host-state dict the watchdog stamps into the heartbeat
+        file each poll: the last logged step (with its phase timings when
+        step timing is on) plus the resilience counters.  Host memory
+        only — reading it can never block on a dead device transport."""
+        with self._lock:
+            return {
+                "last_train": self._last_train,
+                "last_val_step": (self._last_val or {}).get("step"),
+                "counters": dict(self._counters),
+            }
+
+    def write_snapshot(self, path: str) -> None:
+        """Atomic telemetry.json write (the exit snapshot)."""
+        snap = self.snapshot()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+        os.replace(tmp, path)
+
+    def close(self) -> None:
+        for sink in self._sinks:
+            try:
+                sink.close()
+            except Exception:  # one dying sink must not mute the others
+                pass
+        self._sinks = []
+
+
+class JsonlSink:
+    """Append-only metrics.jsonl writer (schema 2).
+
+    Keeps the file handle open across records (the trainer used to
+    open/close per write); ``flush(fsync=True)`` makes everything written
+    so far durable — called at checkpoint boundaries so the metrics
+    stream can never be newer on disk than the checkpoint it describes
+    by more than one interval."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self._f = open(path, "a")
+        self._closed = False
+
+    def log_step(self, step: int, scope: str, metrics: Dict[str, Any],
+                 wall_time: float) -> None:
+        if self._closed:
+            return
+        self._f.write(json.dumps(
+            {"schema": METRICS_SCHEMA, "step": int(step), "scope": scope,
+             "time": wall_time, **metrics}) + "\n")
+        self._f.flush()  # line-buffered semantics, matching the old writer
+
+    def flush(self, fsync: bool = False) -> None:
+        if self._closed:
+            return
+        self._f.flush()
+        if fsync:
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass  # metrics durability is best-effort, never fatal
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush(fsync=True)
+        self._f.close()
+        self._closed = True
+
+
+class ScalarWriterSink:
+    """Adapter from the registry's log_step to utils.tb.ScalarWriter
+    (which tolerates writes after close, so shutdown ordering between
+    the registry and an atexit hook can never raise)."""
+
+    def __init__(self, writer):
+        self._writer = writer
+
+    def log_step(self, step: int, scope: str, metrics: Dict[str, Any],
+                 wall_time: float) -> None:
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._writer.add_scalar(f"{scope}/{k}", v, step)
+
+    def flush(self, fsync: bool = False) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()

@@ -1,0 +1,210 @@
+"""Host-side span tracer with Chrome-trace-event JSON export.
+
+Answers "where does a step's wall-time go?" for the parts of training the
+XLA profiler cannot see: the HOST side — data wait, dispatch, CIDEr-D
+scoring, checkpoint commit (ISSUE 2 / OBSERVABILITY.md).  A span is a
+named wall-clock interval opened with ``tracer.span("data_wait")`` (or the
+``trace_span`` helper when the tracer may be absent); completed spans are
+buffered thread-safely and exported as Chrome trace events — the
+``{"traceEvents": [...]}`` JSON that Perfetto / chrome://tracing load
+directly, with one row per host thread (main loop vs loader prefetch).
+
+Design constraints, in priority order:
+
+- **Disabled = free.**  Nothing here runs unless a tracer object exists;
+  call sites hold ``None`` and pay one is-None check (the ``--fault_plan``
+  pattern).  ``trace_span(None, ...)`` returns a shared no-op singleton —
+  no allocation on the disabled path.
+- **Never inside jit.**  Spans time host code only; device work appears
+  as host *wait* time (the fetch that blocks on it), which is exactly the
+  quantity overlap tuning needs.
+- **Cheap when enabled.**  One ``perf_counter`` pair + one small dict per
+  span, appended under a lock (~1 µs); the buffer rotates to a part file
+  at ``max_buffered_events`` so a long run cannot grow host memory
+  unboundedly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled path of every hook."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+#: The one instance call sites use when their tracer is None.
+NULL_SPAN = _NullSpan()
+
+
+def trace_span(tracer: Optional["SpanTracer"], name: str, **args):
+    """``with trace_span(tracer, "data_wait"): ...`` — no-op when
+    ``tracer`` is None (one is-None check, zero allocation)."""
+    if tracer is None:
+        return NULL_SPAN
+    return tracer.span(name, **args)
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._record(self._name, self._t0, time.perf_counter(),
+                             self._args)
+        return False
+
+
+class SpanTracer:
+    """Thread-safe span buffer + Chrome-trace JSON writer.
+
+    Spans may be opened from any thread (the loader prefetch worker
+    records alongside the main loop — the trace shows them as separate
+    ``tid`` rows, which is how overlap becomes visible).  Files land in
+    ``trace_dir`` as ``trace_<pid>r<k>[_partN].json``; each is a
+    complete, independently loadable Chrome trace (a rotated long run
+    yields several).  ``r<k>`` is a process-global tracer sequence
+    number, so two tracers sharing one pid AND one trace_dir — two train
+    stages in one script, like scripts/trace_demo.py — append distinct
+    files instead of the second clobbering the first's.
+    """
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, trace_dir: str, process_index: int = 0,
+                 max_buffered_events: int = 200_000):
+        self._dir = os.path.abspath(trace_dir)
+        os.makedirs(self._dir, exist_ok=True)
+        self._pid = os.getpid()
+        with SpanTracer._seq_lock:
+            self._run = SpanTracer._seq
+            SpanTracer._seq += 1
+        self._process_index = int(process_index)
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._named_tids: set = set()
+        self._max = max(1000, int(max_buffered_events))
+        self._part = 0
+        self._closed = False
+        # ts epoch: perf_counter is monotonic but has an arbitrary zero;
+        # anchor it once so every event's ts is "µs since tracer start"
+        # and the wall-clock anchor rides in the file's otherData.
+        self._t_epoch = time.perf_counter()
+        self._wall_epoch = time.time()
+        self._events.append({
+            "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"name": f"cst_captioning_tpu host "
+                             f"(process {self._process_index})"},
+        })
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **args) -> _Span:
+        """Context manager timing one host interval; nests naturally."""
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker event (e.g. a fault firing)."""
+        now = time.perf_counter()
+        ev = {"name": name, "ph": "i", "s": "t", "cat": "host",
+              "ts": (now - self._t_epoch) * 1e6,
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _record(self, name: str, t0: float, t1: float,
+                args: Optional[Dict[str, Any]]) -> None:
+        ev = {"name": name, "ph": "X", "cat": "host",
+              "ts": (t0 - self._t_epoch) * 1e6,
+              "dur": (t1 - t0) * 1e6,
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        tid = ev["tid"]
+        rotate = None
+        with self._lock:
+            if self._closed:
+                return  # a straggler worker thread after close: drop, not die
+            if tid not in self._named_tids:
+                self._named_tids.add(tid)
+                self._events.append({
+                    "name": "thread_name", "ph": "M", "pid": self._pid,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                })
+            self._events.append(ev)
+            if len(self._events) >= self._max:
+                rotate = self._take_events_locked()
+        if rotate is not None:
+            self._write_part(*rotate)
+
+    def _take_events_locked(self):
+        """-> (events, part_path); claims the part number under the lock
+        so concurrent rotations cannot collide on a file name."""
+        events, self._events = self._events, []
+        # thread-name metadata must reappear in every part file so each
+        # one loads self-described.
+        self._named_tids.clear()
+        suffix = "" if self._part == 0 else f"_part{self._part}"
+        self._part += 1
+        return events, os.path.join(
+            self._dir, f"trace_{self._pid}r{self._run}{suffix}.json")
+
+    # -- export ------------------------------------------------------------
+
+    def _write_part(self, events: List[Dict[str, Any]], path: str) -> None:
+        if not events:
+            return
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "pid": self._pid,
+                "process_index": self._process_index,
+                "wall_epoch_unix_s": self._wall_epoch,
+            },
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)  # a killed run leaves whole files, not torn
+
+    def flush(self) -> None:
+        """Write buffered events out now (a complete part file)."""
+        with self._lock:
+            events, path = self._take_events_locked()
+        self._write_part(events, path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            events, path = self._take_events_locked()
+            self._closed = True
+        self._write_part(events, path)

@@ -21,6 +21,7 @@ import orbax.checkpoint as ocp
 
 from ..resilience import integrity
 from ..resilience.faults import FaultPlan
+from ..telemetry.spans import NULL_SPAN
 
 log = logging.getLogger(__name__)
 
@@ -48,16 +49,25 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 2,
                  keep_best: bool = True,
                  fault_plan: Optional[FaultPlan] = None,
-                 readonly: bool = False):
+                 readonly: bool = False,
+                 telemetry=None):
         """``readonly=True`` is for consumers that only restore (eval,
         stage warm-start): it skips the destructive quarantine scan and
         infos scrub, so a reader can never rename a step out from under
         the trainer that owns the directory (e.g. during the owner's
         post-commit manifest-hash window, when marker-without-manifest
         legitimately exists for a moment).  Readers stay safe via
-        restore's full verification + walk-back."""
+        restore's full verification + walk-back.
+
+        ``telemetry`` (a ``telemetry.Telemetry``, optional): commit/
+        verify/restore get host spans in the trace, and the integrity
+        layer's outcomes count into the registry
+        (``checkpoints_saved``/``checkpoints_quarantined``/
+        ``checkpoint_walkbacks``) so a recovery's story is auditable in
+        the exit telemetry.json.  None = one is-None check per event."""
         self.directory = os.path.abspath(directory)
         self._faults = fault_plan
+        self._telemetry = telemetry
         self._verify_cache: Dict[tuple, Tuple[str, str]] = {}
         os.makedirs(self.directory, exist_ok=True)
         # BEFORE orbax indexes anything: a step torn by a crash mid-save
@@ -104,6 +114,18 @@ class CheckpointManager:
             )
         return self._recovery
 
+    # -- telemetry hooks (one is-None check each when disarmed) ------------
+
+    def _span(self, name: str, **args):
+        tel = self._telemetry
+        if tel is None or tel.tracer is None:
+            return NULL_SPAN
+        return tel.tracer.span(name, **args)
+
+    def _inc(self, name: str) -> None:
+        if self._telemetry is not None:
+            self._telemetry.inc(name)
+
     # -- save --------------------------------------------------------------
 
     def save(self, step: int, state, score: Optional[float] = None,
@@ -124,16 +146,18 @@ class CheckpointManager:
         # ``params`` is saved as its own entry so the next stage can
         # warm-start weights without matching this stage's optimizer
         # structure (XE -> WXE -> CST chaining, SURVEY.md §5).
-        mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                params=ocp.args.StandardSave(state.params),
-            ),
-            metrics=metrics,
-        )
-        mgr.wait_until_finished()
-        self._seal_step(step, recovery=score is None)
+        with self._span("ckpt_commit", step=int(step)):
+            mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    params=ocp.args.StandardSave(state.params),
+                ),
+                metrics=metrics,
+            )
+            mgr.wait_until_finished()
+            self._seal_step(step, recovery=score is None)
+        self._inc("checkpoints_saved")
         if score is not None and (
             self.infos["best_score"] is None or score > self.infos["best_score"]
         ):
@@ -241,15 +265,17 @@ class CheckpointManager:
         the most recent one, never affects best-score bookkeeping."""
         mgr = self._recovery_mgr()
         self._clear_existing(mgr, step)
-        mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                params=ocp.args.StandardSave(state.params),
-            ),
-        )
-        mgr.wait_until_finished()
-        self._seal_step(step, recovery=True)
+        with self._span("ckpt_commit", step=int(step), recovery=True):
+            mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    params=ocp.args.StandardSave(state.params),
+                ),
+            )
+            mgr.wait_until_finished()
+            self._seal_step(step, recovery=True)
+        self._inc("checkpoints_saved")
 
     # -- integrity ---------------------------------------------------------
 
@@ -286,6 +312,7 @@ class CheckpointManager:
                     continue
                 self._quarantined.append(
                     (int(name), base != self.directory))
+                self._inc("checkpoints_quarantined")
                 log.warning(
                     "quarantined torn checkpoint step %s (%s) -> %s; "
                     "resume will use the newest verified step", name,
@@ -355,7 +382,8 @@ class CheckpointManager:
         key = (step_dir, mkey, sig)
         hit = self._verify_cache.get(key)
         if hit is None:
-            hit = integrity.verify_step_dir(step_dir)
+            with self._span("ckpt_verify", dir=os.path.basename(step_dir)):
+                hit = integrity.verify_step_dir(step_dir)
             self._verify_cache[key] = hit
         return hit
 
@@ -465,6 +493,7 @@ class CheckpointManager:
             log.warning("checkpoint step %d failed integrity verification "
                         "(%s); walking back", cand, detail)
             excluded.add(cand)
+            self._inc("checkpoint_walkbacks")
 
     def _mgr_for(self, step: int) -> ocp.CheckpointManager:
         if step in self._mgr.all_steps():
@@ -478,10 +507,12 @@ class CheckpointManager:
         step = self._resolve_step(step, best)
         target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                         abstract_state)
-        out = self._mgr_for(step).restore(
-            step,
-            args=ocp.args.Composite(state=ocp.args.StandardRestore(target)),
-        )
+        with self._span("ckpt_restore", step=int(step)):
+            out = self._mgr_for(step).restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(target)),
+            )
         return out["state"]
 
     def restore_params(self, abstract_params, step: Optional[int] = None,
@@ -490,10 +521,12 @@ class CheckpointManager:
         step = self._resolve_step(step, best)
         target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                         abstract_params)
-        out = self._mgr_for(step).restore(
-            step,
-            args=ocp.args.Composite(params=ocp.args.StandardRestore(target)),
-        )
+        with self._span("ckpt_restore", step=int(step), params_only=True):
+            out = self._mgr_for(step).restore(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore(target)),
+            )
         return out["params"]
 
     def close(self) -> None:

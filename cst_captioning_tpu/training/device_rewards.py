@@ -103,12 +103,33 @@ def build_device_tables(
     word_to_ix: Optional[Mapping[str, int]] = None,
     external_df: Optional[Mapping[Tuple[str, ...], float]] = None,
     external_ref_len: Optional[float] = None,
+    telemetry=None,
 ) -> Tuple[CorpusTable, RefTables, Dict[str, int]]:
     """-> (CorpusTable, RefTables, {video_id: row index}) as DEVICE arrays.
 
     Row order follows ``tokenized_refs`` iteration order; pass an ordered
     mapping in dataset order so ``Batch.video_ix`` indexes rows directly.
+
+    ``telemetry``: a ``--trace_dir`` run records the one-time table build
+    as a ``device_reward_tables`` span — it is the fused path's dominant
+    startup cost at real corpus scale, and naming it keeps a slow startup
+    diagnosable from the trace alone.
     """
+    if telemetry is not None:
+        with telemetry.span("device_reward_tables",
+                            videos=len(tokenized_refs)):
+            return _build_device_tables(tokenized_refs, word_to_ix,
+                                        external_df, external_ref_len)
+    return _build_device_tables(tokenized_refs, word_to_ix,
+                                external_df, external_ref_len)
+
+
+def _build_device_tables(
+    tokenized_refs: Mapping[str, Sequence[str]],
+    word_to_ix: Optional[Mapping[str, int]] = None,
+    external_df: Optional[Mapping[Tuple[str, ...], float]] = None,
+    external_ref_len: Optional[float] = None,
+) -> Tuple[CorpusTable, RefTables, Dict[str, int]]:
     import jax.numpy as jnp
 
     enc = _Encoder(word_to_ix)

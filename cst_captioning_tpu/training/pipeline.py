@@ -41,6 +41,11 @@ class RewardPipeline:
         -> (advantage (N,), stats dict)`` — the RewardComputer call; ``ctx``
         is whatever per-batch payload it needs (video ids).
       depth: rollouts kept in flight (``--overlap_rewards``); 0 = serial.
+      telemetry: optional ``telemetry.Telemetry`` — the fetch that blocks
+        on the device rollout gets a ``fetch_wait`` host span (the reward
+        compute itself is spanned inside the RewardComputer), making the
+        overlap visible in a ``--trace_dir`` Chrome trace alongside the
+        ``--profile_dir`` TraceAnnotations.  None = one is-None check.
     """
 
     def __init__(
@@ -49,11 +54,13 @@ class RewardPipeline:
         rl_step_fn: Callable,
         advantage_fn: Callable,
         depth: int,
+        telemetry=None,
     ):
         self.rollout_fn = rollout_fn
         self.rl_step_fn = rl_step_fn
         self.advantage_fn = advantage_fn
         self.depth = max(0, int(depth))
+        self._telemetry = telemetry
         self._pending: List[Tuple] = []
 
     def __len__(self) -> int:
@@ -80,10 +87,15 @@ class RewardPipeline:
 
     def _complete_one(self, state) -> Tuple[Any, Tuple[Any, Dict[str, float]]]:
         sampled, fetch, feats, step_rng, ctx = self._pending.pop(0)
+        tel = self._telemetry
         # TraceAnnotations make the host gap legible in a --profile_dir
         # trace: fetch-wait (device + transfer latency) vs reward compute.
         with jax.profiler.TraceAnnotation("cst/fetch_wait"):
-            fetched = np.asarray(jax.device_get(fetch))
+            if tel is None:
+                fetched = np.asarray(jax.device_get(fetch))
+            else:
+                with tel.span("fetch_wait"):
+                    fetched = np.asarray(jax.device_get(fetch))
         n = sampled.shape[0]
         greedy_rows = fetched[n:] if fetched.shape[0] > n else None
         with jax.profiler.TraceAnnotation("cst/host_reward"):

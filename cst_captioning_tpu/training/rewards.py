@@ -60,6 +60,7 @@ class RewardComputer:
         baseline: str = "greedy",
         consensus_scores: Optional[Mapping[str, np.ndarray]] = None,
         scb_captions: int = 0,
+        telemetry=None,
     ):
         if baseline not in BASELINES:
             raise ValueError(f"baseline {baseline!r} not in {BASELINES}")
@@ -69,6 +70,10 @@ class RewardComputer:
             raise ValueError("scb-gt baseline needs precomputed consensus scores")
         self.vocab = vocab
         self.scorer = scorer
+        # Optional telemetry.Telemetry: scoring is the CST stage's host
+        # gap, so it gets the "score" step phase (and trace span) when
+        # instrumentation is armed — None costs one is-None check/call.
+        self._telemetry = telemetry
         # Native scorer (cst_captioning_tpu.native.NativeCiderD) consumes
         # token-id arrays directly — no id->string->split round trip.
         self._native = hasattr(scorer, "score_ids")
@@ -110,6 +115,18 @@ class RewardComputer:
         greedy: Optional[np.ndarray] = None, # (B, L), greedy baseline only
     ) -> Tuple[np.ndarray, Dict[str, float]]:
         """-> (advantage (B*S,) float32, stats for logging)."""
+        tel = self._telemetry
+        if tel is None:
+            return self._compute(video_ids, sampled, greedy)
+        with tel.phase("score"):
+            return self._compute(video_ids, sampled, greedy)
+
+    def _compute(
+        self,
+        video_ids: Sequence[str],
+        sampled: np.ndarray,
+        greedy: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, Dict[str, float]]:
         S = self.seq_per_img
         r_sample = self._reward(video_ids, sampled)
 

@@ -19,11 +19,11 @@ loader's prefetch thread.
 
 from __future__ import annotations
 
-import json
+import atexit
 import logging
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -49,6 +49,13 @@ from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
 from ..resilience.faults import FaultPlan
 from ..resilience.guard import DivergenceGuard
+from ..telemetry import (
+    JsonlSink,
+    ScalarWriterSink,
+    Telemetry,
+    caption_step_flops,
+    mfu_fields,
+)
 from ..utils.watchdog import ProgressWatchdog
 from .checkpoint import CheckpointManager
 from .evaluation import eval_split
@@ -173,6 +180,13 @@ class Trainer:
             getattr(opt, "wedge_timeout", 0.0) or 0.0,
             describe=lambda: ("last loop step %d; checkpoints in %s"
                               % (self._progress_step, opt.checkpoint_path)),
+            # Liveness file an external harness can read without attaching:
+            # last beat gap + the telemetry registry's last-step record and
+            # resilience counters.  payload reads HOST state only (same
+            # contract as describe — see ProgressWatchdog docstring).
+            heartbeat_path=os.path.join(
+                os.path.abspath(opt.checkpoint_path), "heartbeat.json"),
+            payload=self._heartbeat_payload,
         ).start()
         try:
             self._init(opt)
@@ -183,7 +197,23 @@ class Trainer:
             self._watchdog.stop()
             raise
 
+    def _heartbeat_payload(self) -> Dict[str, Any]:
+        """Watchdog-thread heartbeat enrichment — host memory only."""
+        payload: Dict[str, Any] = {"loop_step": self._progress_step}
+        tel = getattr(self, "_telemetry", None)  # watchdog arms before _init
+        if tel is not None:
+            payload.update(tel.registry.heartbeat_payload())
+        return payload
+
     def _init(self, opt):
+        # Telemetry bundle (telemetry/__init__.py): the metrics registry
+        # always exists (counters are how rare resilience events become
+        # auditable); the span tracer / step-phase timer stay None unless
+        # --trace_dir / --step_timing arm them, and every hot-path hook
+        # then costs one is-None check — the --fault_plan pattern.  Sinks
+        # (metrics.jsonl, TB) attach at the end of _init, once the process
+        # knows it is the pod's metrics writer.
+        self._telemetry = Telemetry.from_opts(opt)
         if opt.eval_metric not in self.KNOWN_EVAL_METRICS:
             # Fail at startup, not after the first epoch's validation
             # silently scores 0.0 forever.
@@ -201,6 +231,9 @@ class Trainer:
             getattr(opt, "fault_plan", None)
             or os.environ.get("CST_FAULT_PLAN"))
         if self._faults is not None:
+            # Firings count into the registry so the drill's telemetry.json
+            # carries fault_firings / fault_<kind> for the audit.
+            self._faults.bind_metrics(self._telemetry.registry)
             # Persist firings next to the checkpoints: process-killing
             # faults (wedge) stay single-shot across the resume attempts a
             # recovery harness (scale_chain) spawns for this stage dir.
@@ -229,6 +262,7 @@ class Trainer:
         self._guard = DivergenceGuard(
             max_bad=getattr(opt, "divergence_max_bad", 3),
             max_rollbacks=getattr(opt, "divergence_max_rollbacks", 2),
+            metrics=self._telemetry.registry,
         ) if guard_on else None
         self._rng_salt = 0  # bumped per rollback: re-seeds the rollout keys
         self.rng = jax.random.PRNGKey(opt.seed)
@@ -332,7 +366,8 @@ class Trainer:
 
         self.ckpt = CheckpointManager(opt.checkpoint_path,
                                       max_to_keep=opt.max_checkpoints,
-                                      fault_plan=self._faults)
+                                      fault_plan=self._faults,
+                                      telemetry=self._telemetry)
         resume_step = self.ckpt.latest_verified_step
         if resume_step is not None:
             latest = self.ckpt.latest_step
@@ -392,19 +427,57 @@ class Trainer:
         self.history: Dict[str, Any] = {"val": []}
 
         # -- observability: metrics.jsonl always, TensorBoard opt-in -------
+        # Step records fan out through the telemetry registry (ONE write
+        # surface instead of ad-hoc dict writes): metrics.jsonl (schema 2)
+        # + optional TB scalars, with a telemetry.json snapshot on exit.
+        # Sinks attach on process 0 only — one metrics stream per pod;
+        # counters still count on every process (host-local audit).
         self._metrics_path = os.path.join(
             os.path.abspath(opt.checkpoint_path), "metrics.jsonl"
         )
         self._tb = None
-        if getattr(opt, "tensorboard", 0) and jax.process_index() == 0:
-            try:
-                from ..utils.tb import ScalarWriter
+        if jax.process_index() == 0:
+            self._telemetry.registry.add_sink(JsonlSink(self._metrics_path))
+            self._telemetry.snapshot_path = os.path.join(
+                os.path.abspath(opt.checkpoint_path), "telemetry.json")
+            if getattr(opt, "tensorboard", 0):
+                try:
+                    from ..utils.tb import ScalarWriter
 
-                self._tb = ScalarWriter(
-                    os.path.join(os.path.abspath(opt.checkpoint_path), "tb")
-                )
-            except ImportError as e:  # tensorboard pkg not installed
-                log.warning("tensorboard writer unavailable: %s", e)
+                    self._tb = ScalarWriter(
+                        os.path.join(os.path.abspath(opt.checkpoint_path),
+                                     "tb")
+                    )
+                    self._telemetry.registry.add_sink(
+                        ScalarWriterSink(self._tb))
+                except ImportError as e:  # tensorboard pkg not installed
+                    log.warning("tensorboard writer unavailable: %s", e)
+        # finally/atexit double cover: train.py's finally calls close(),
+        # and the atexit hook flushes TB events + the telemetry snapshot
+        # when a run dies mid-epoch down a path that never reaches close()
+        # (telemetry.close and ScalarWriter.close are both idempotent).
+        atexit.register(self._telemetry.close)
+
+        # -- live MFU accounting (--step_timing / --trace_dir) -------------
+        # Same arithmetic as bench.py (telemetry/flops.py — shared so the
+        # in-trainer gauge and the offline benchmark cannot drift), at the
+        # RUN's real shapes: this run's feature modalities, vocab, decode
+        # length.  PER-CHIP like bench's captions/s/chip: the step is
+        # batch-sharded over the mesh, so each chip computes a 1/mesh.size
+        # share — dividing here keeps mfu_pct comparable against ONE
+        # chip's peak instead of reading mesh.size-times too high on a
+        # pod slice.  Estimate note: assumes embed = attn = hidden.
+        self._flops_per_step = None
+        if self._telemetry.phases is not None:
+            stage = "cst" if opt.use_rl else "xe"
+            flops = caption_step_flops(
+                opt.batch_size, opt.seq_per_img,
+                opt.max_length if opt.use_rl else self.train_ds.seq_length,
+                self.vocab.size_with_pad, opt.rnn_size,
+                feat_shapes=feat_shapes,
+            )
+            self._flops_per_step = flops[stage] / max(1, self.mesh.size)
+            self._device_kind = getattr(jax.devices()[0], "device_kind", "")
 
     def _maybe_log_train(self, step1: int, metrics: Dict[str, float],
                          total_steps: int, bpe: int) -> None:
@@ -431,6 +504,17 @@ class Trainer:
             cps = self._captions_done / max(dt, 1e-9)
             extra["captions_per_sec"] = cps
             cps_txt = f" | {cps:.0f} captions/s"
+            # Step-phase + MFU gauges (--step_timing / --trace_dir): the
+            # interval's wall-time partition (host-attributed; exclusive
+            # — see telemetry/phases.py) and the live utilization the
+            # analytic FLOPs model implies.  mfu_pct is null off-TPU.
+            phases = self._telemetry.phases
+            if phases is not None:
+                ncaps = self.opt.batch_size * self.opt.seq_per_img
+                extra.update(phases.drain_ms(
+                    max(1, round(self._captions_done / ncaps))))
+                extra.update(mfu_fields(self._flops_per_step, cps, ncaps,
+                                        self._device_kind))
             self._log_t0, self._captions_done = time.time(), 0
         log.info(
             "step %d/%d epoch %.2f %s lr %.2e%s",
@@ -478,15 +562,12 @@ class Trainer:
 
     def _log_metrics(self, step: int, scope: str,
                      metrics: Dict[str, float]) -> None:
-        if jax.process_index() != 0:  # one metrics stream per pod
-            return
-        with open(self._metrics_path, "a") as f:
-            f.write(json.dumps(
-                {"step": step, "scope": scope, "time": time.time(), **metrics}
-            ) + "\n")
-        if self._tb is not None:
-            for k, v in metrics.items():
-                self._tb.add_scalar(f"{scope}/{k}", v, step)
+        # One fan-out surface: metrics.jsonl (schema 2) + TB scalars via
+        # the registry's sinks (attached on process 0 only — a non-zero
+        # process's registry has no sinks, so this is a cheap no-op there)
+        # plus the last-record bookkeeping the heartbeat/exit snapshot
+        # read.
+        self._telemetry.registry.log_step(step, scope, metrics)
 
     # -- device-resident features -----------------------------------------
 
@@ -614,6 +695,7 @@ class Trainer:
             baseline=opt.rl_baseline,
             consensus_scores=self.consensus_scores,
             scb_captions=opt.scb_captions,
+            telemetry=self._telemetry,
         )
         rollout_raw = make_rollout_fused(
             self.model, opt.max_length, opt.seq_per_img,
@@ -651,6 +733,7 @@ class Trainer:
             # metric attribution honest under the pipeline lag.
             lambda ctx, s, g: self.reward_computer(ctx[1], s, g),
             depth=getattr(opt, "overlap_rewards", DEFAULT_OVERLAP_REWARDS),
+            telemetry=self._telemetry,
         )
 
     def _setup_fused_rl(self, refs) -> None:
@@ -681,6 +764,7 @@ class Trainer:
         corpus, tables, video_row = build_device_tables(
             refs, self.vocab.word_to_ix,
             external_df=external_df, external_ref_len=external_ref_len,
+            telemetry=self._telemetry,
         )
         scb_gt = None
         if opt.rl_baseline == "scb-gt":
@@ -917,6 +1001,27 @@ class Trainer:
 
     # -- main loop ---------------------------------------------------------
 
+    def _profile_window(self) -> Optional[Tuple[int, int]]:
+        """Loop-step window [start, stop) for the programmatic
+        jax.profiler trace; None when --profile_dir is unset.
+        ``--profile_steps`` is either a COUNT (window starts at
+        --profile_start, the historical form) or an explicit ``A:B``."""
+        opt = self.opt
+        if not opt.profile_dir:
+            return None
+        spec = str(getattr(opt, "profile_steps", "10")).strip()
+        if ":" in spec:
+            a, b = spec.split(":", 1)
+            start, stop = int(a), int(b)
+        else:
+            start = int(getattr(opt, "profile_start", 10))
+            stop = start + int(spec)
+        if stop <= start:
+            raise ValueError(
+                f"--profile_steps {spec!r} with --profile_start "
+                f"{getattr(opt, 'profile_start', 10)} is an empty window")
+        return start, stop
+
     def validate(self) -> Optional[Dict[str, float]]:
         if self.val_loader is None:
             return None
@@ -953,6 +1058,7 @@ class Trainer:
             self.loader, size=2,
             device_put=lambda x: jax.device_put(x, self._batch_sharding),
             feat_dtype=self._feat_dtype(),
+            telemetry=self._telemetry,
         ))
         start_step = int(self.state.step)
         total_steps = opt.max_epochs * bpe
@@ -986,6 +1092,12 @@ class Trainer:
                 self._maybe_log_train(k + 1, m, total_steps, bpe)
 
         profiling = False
+        profile_window = self._profile_window()
+        # Phase hooks below follow the --fault_plan pattern: ``ph`` is
+        # None unless --trace_dir/--step_timing armed it, and the disabled
+        # path of every hook is exactly one is-None check — no context
+        # manager, no allocation, nothing near a jitted program.
+        ph = self._telemetry.phases
         step = start_step
         # while (not for): a divergence rollback rewinds ``step`` to the
         # restored checkpoint and replays from there.
@@ -999,24 +1111,42 @@ class Trainer:
                              "loop (the watchdog must turn this into exit "
                              "%s)", step + 1, "124")
                 time.sleep(2 ** 31)
-            if opt.profile_dir:
-                if step == opt.profile_start and not profiling:
+            if profile_window is not None:
+                if step == profile_window[0] and not profiling:
                     jax.profiler.start_trace(opt.profile_dir)
                     profiling = True
-                elif profiling and step == opt.profile_start + opt.profile_steps:
+                elif profiling and step == profile_window[1]:
                     jax.profiler.stop_trace()
                     profiling = False
                     log.info("profiler trace written to %s", opt.profile_dir)
-            batch = next(it)
+            if ph is None:
+                batch = next(it)
+            else:
+                with ph.phase("data_wait"):
+                    batch = next(it)
             self._captions_done += opt.batch_size * opt.seq_per_img
             if opt.use_rl:
                 # Completed steps lag dispatch by the pipeline depth; each
                 # is logged under ITS OWN step index, not the loop's.
-                for k, m in self._rl_iteration(batch):
+                # "compute" covers dispatch + completion (the host-path
+                # score nests inside and is attributed exclusively — see
+                # telemetry/phases.py); logging's metric fetch stays
+                # outside so a device sync at the log boundary shows up
+                # as its own cost, not as compute.
+                if ph is None:
+                    completed = self._rl_iteration(batch)
+                else:
+                    with ph.phase("compute"):
+                        completed = self._rl_iteration(batch)
+                for k, m in completed:
                     self._observe_guard(k, m)
                     self._maybe_log_train(k + 1, m, total_steps, bpe)
             else:
-                metrics = self._xe_iteration(batch)
+                if ph is None:
+                    metrics = self._xe_iteration(batch)
+                else:
+                    with ph.phase("compute"):
+                        metrics = self._xe_iteration(batch)
                 self._observe_guard(step, metrics)
                 self._maybe_log_train(step + 1, metrics, total_steps, bpe)
             if self._guard is not None and self._guard.poll():
@@ -1030,8 +1160,16 @@ class Trainer:
                     and (step + 1) % bpe != 0):  # epoch boundary saves below
                 if opt.use_rl:
                     drain_and_log()  # checkpoint must include all updates
-                self.ckpt.save_recovery(step + 1, self.state)
+                # Cold sites (seconds of orbax work) use the facade — the
+                # disarmed case returns the shared no-op; only the
+                # per-step data_wait/compute hooks above keep the explicit
+                # is-None branch.
+                with self._telemetry.phase("ckpt"):
+                    self.ckpt.save_recovery(step + 1, self.state)
                 self._snapshot_good_state(step + 1)
+                # Checkpoint boundary: make the metrics stream durable with
+                # the state it describes (schema-2 contract, ISSUE 2).
+                self._telemetry.flush(fsync=True)
 
             if (step + 1) % bpe == 0:  # epoch boundary
                 if opt.use_rl:
@@ -1059,11 +1197,13 @@ class Trainer:
                         patience += 1
                     # patience rides in infos so the save reflects THIS
                     # epoch's outcome and a resume restores it exactly.
-                    self.ckpt.save(step + 1, self.state, score=metric,
-                                   extra={"opt": vars(opt),
-                                          "val_scores": scores,
-                                          "patience": patience})
+                    with self._telemetry.phase("ckpt"):
+                        self.ckpt.save(step + 1, self.state, score=metric,
+                                       extra={"opt": vars(opt),
+                                              "val_scores": scores,
+                                              "patience": patience})
                     self._snapshot_good_state(step + 1)
+                    self._telemetry.flush(fsync=True)  # durable with state
                     self._watchdog.beat()  # orbax fetch+write completed
                     # min_epochs floors the STOP, not the patience count:
                     # epochs without improvement keep accumulating, but
@@ -1075,8 +1215,10 @@ class Trainer:
                                  opt.eval_metric, patience)
                         break
                 else:
-                    self.ckpt.save(step + 1, self.state)
+                    with self._telemetry.phase("ckpt"):
+                        self.ckpt.save(step + 1, self.state)
                     self._snapshot_good_state(step + 1)
+                    self._telemetry.flush(fsync=True)
             step += 1
 
         if opt.use_rl:
@@ -1099,8 +1241,17 @@ class Trainer:
 
     def close(self) -> None:
         try:
+            # Telemetry first: the exit telemetry.json snapshot + sink
+            # close (which closes the TB writer) must not be hostage to a
+            # device-touching close below hanging on a dead transport.
+            # Idempotent, so the still-registered atexit hook is a no-op.
+            self._telemetry.close()
+            try:
+                atexit.unregister(self._telemetry.close)
+            except Exception:
+                pass
             if self._tb is not None:
-                self._tb.close()
+                self._tb.close()  # already closed via the sink; tolerated
             # ckpt.close() joins orbax's async writer — a device fetch
             # that can block on a dead transport, so the watchdog must
             # outlive it (a false 124 here costs one cheap resume; a hang

@@ -17,6 +17,12 @@ class ScalarWriter:
     Raises ImportError at construction if the tensorboard package is not
     installed — callers decide whether that is fatal (the trainer warns and
     continues; metrics.jsonl is always written regardless).
+
+    Lifecycle: usable as a context manager, and ``close()`` is idempotent
+    with ``add_scalar``/``flush`` after close tolerated as no-ops — the
+    trainer closes via ``finally`` AND registers an atexit hook so events
+    are not lost when a run dies mid-epoch, and that double/late-close
+    ordering must never raise or resurrect the writer.
     """
 
     def __init__(self, logdir: str):
@@ -29,8 +35,17 @@ class ScalarWriter:
         self._Event = Event
         self._Summary = Summary
         self._writer = EventFileWriter(logdir)
+        self._closed = False
+
+    def __enter__(self) -> "ScalarWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._closed:
+            return  # late write after shutdown: dropped, not raised
         event = self._Event(
             step=int(step),
             wall_time=time.time(),
@@ -42,8 +57,13 @@ class ScalarWriter:
         self._writer.add_event(event)
 
     def flush(self) -> None:
+        if self._closed:
+            return
         self._writer.flush()
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._writer.flush()
         self._writer.close()

@@ -25,10 +25,11 @@ failure to surface.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 #: Exit status for "no progress within the timeout" — matches coreutils
 #: ``timeout(1)`` so shell-level and watchdog-level wedge kills look alike.
@@ -42,14 +43,29 @@ class ProgressWatchdog:
     read just delays detection by one poll interval) and safe from any
     thread.  A ``timeout_s`` of 0 disables the watchdog entirely; every
     method is then a no-op, so call sites need no conditionals.
+
+    Heartbeat file: with ``heartbeat_path`` set, the monitor thread also
+    writes a small JSON status file at thread start and once per poll —
+    liveness PLUS context (``payload()``, e.g. the telemetry registry's
+    last-step phase timings and resilience counters) that an external
+    harness can read without attaching to the process.  The payload
+    callable must only touch HOST state, exactly like ``describe``: it
+    runs while the main thread may be wedged inside a dead transport, and
+    a device fetch here would hang the very thread reporting the hang.
+    Writes are atomic (tmp + replace) and best-effort — observability
+    must never kill the run it observes.
     """
 
     def __init__(self, timeout_s: float,
                  describe: Optional[Callable[[], str]] = None,
-                 on_timeout: Optional[Callable[[float], None]] = None):
+                 on_timeout: Optional[Callable[[float], None]] = None,
+                 heartbeat_path: Optional[str] = None,
+                 payload: Optional[Callable[[], Dict]] = None):
         self.timeout_s = float(timeout_s)
         self._describe = describe or (lambda: "")
         self._on_timeout = on_timeout or self._die
+        self._heartbeat_path = heartbeat_path
+        self._payload = payload
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -69,6 +85,10 @@ class ProgressWatchdog:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+            # Final heartbeat: the file's last state reflects the run's
+            # END (full counters, last step), not whichever poll happened
+            # to land last — heartbeats mid-run are poll-cadenced.
+            self._write_heartbeat(time.monotonic() - self._last)
 
     def __enter__(self) -> "ProgressWatchdog":
         return self.start()
@@ -81,10 +101,30 @@ class ProgressWatchdog:
         self._last = time.monotonic()
 
     # -- internals ---------------------------------------------------------
+    def _write_heartbeat(self, gap: float) -> None:
+        if self._heartbeat_path is None:
+            return
+        try:
+            doc = {"time": time.time(), "pid": os.getpid(),
+                   "beat_gap_s": round(gap, 3),
+                   "timeout_s": self.timeout_s}
+            if self._payload is not None:
+                doc.update(self._payload() or {})
+            os.makedirs(os.path.dirname(
+                os.path.abspath(self._heartbeat_path)), exist_ok=True)
+            tmp = self._heartbeat_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, self._heartbeat_path)
+        except Exception:
+            pass  # best-effort: a full disk must not look like a wedge
+
     def _run(self) -> None:
         poll = max(1.0, min(30.0, self.timeout_s / 4.0))
+        self._write_heartbeat(time.monotonic() - self._last)
         while not self._stop.wait(poll):
             gap = time.monotonic() - self._last
+            self._write_heartbeat(gap)
             if gap > self.timeout_s:
                 self._on_timeout(gap)
                 # The default handler never returns (os._exit).  An

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Zero-setup telemetry demo: short CPU train with --trace_dir, then the
+scripts/trace_report.py per-phase table (`make trace-demo`).
+
+Synthesizes a tiny dataset, runs one XE stage and one host-reward CST
+stage (the host path is the one with a visible `score` phase) with span
+tracing + step timing armed, then summarizes the trace dir and points at
+the other artifacts a telemetry-enabled run produces:
+
+- <trace_dir>/trace_*.json — load in Perfetto / chrome://tracing
+- <ckpt>/metrics.jsonl     — schema-2 records with *_ms + mfu_pct gauges
+- <ckpt>/telemetry.json    — exit snapshot (counters, last records)
+
+OBSERVABILITY.md documents the span/metric taxonomy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out_dir", default="/tmp/cst_trace_demo")
+    p.add_argument("--epochs", type=int, default=2)
+    args = p.parse_args()
+
+    from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+    from cst_captioning_tpu.data.vocab import load_vocab
+    import train as train_cli
+
+    root = os.path.join(args.out_dir, "data")
+    ckpt = os.path.join(args.out_dir, "checkpoints")
+    trace_dir = os.path.join(args.out_dir, "trace")
+    os.makedirs(root, exist_ok=True)
+
+    spec = SyntheticSpec(num_videos=16, captions_per_video=5, max_len=12,
+                         feat_dims=(32, 16), feat_times=(4, 1))
+    train = generate(root, "train", spec)
+    vocab = load_vocab(train["vocab_json"])
+    val = generate(root, "val",
+                   SyntheticSpec(num_videos=8, captions_per_video=5,
+                                 max_len=12, feat_dims=(32, 16),
+                                 feat_times=(4, 1)), vocab=vocab)
+
+    common = [
+        "--train_feat_h5", *json.loads(train["feat_h5"]),
+        "--train_label_h5", train["label_h5"],
+        "--train_info_json", train["info_json"],
+        "--train_cocofmt_file", train["cocofmt_json"],
+        "--val_feat_h5", *json.loads(val["feat_h5"]),
+        "--val_label_h5", val["label_h5"],
+        "--val_info_json", val["info_json"],
+        "--val_cocofmt_file", val["cocofmt_json"],
+        "--batch_size", "8", "--seq_per_img", "4",
+        "--rnn_size", "64", "--input_encoding_size", "32", "--att_size", "32",
+        "--max_length", "12", "--drop_prob", "0.2",
+        "--max_epochs", str(args.epochs), "--learning_rate", "0.01",
+        "--log_every", "1", "--fast_val", "1", "--max_patience", "0",
+        "--trace_dir", trace_dir,
+    ]
+
+    print("=== stage 1/2: XE with span tracing ===")
+    train_cli.main([*common, "--checkpoint_path", f"{ckpt}/xe"])
+
+    print("=== stage 2/2: CST (host rewards — shows the `score` phase) ===")
+    train_cli.main([
+        *common, "--checkpoint_path", f"{ckpt}/cst",
+        "--start_from", f"{ckpt}/xe",
+        "--use_rl", "1", "--rl_baseline", "greedy",
+        "--device_rewards", "0", "--overlap_rewards", "1",
+        "--train_cached_tokens", train["cached_tokens"],
+        "--learning_rate", "0.0005", "--max_epochs", "1",
+    ])
+
+    print("\n=== per-phase trace summary ===")
+    import trace_report
+
+    events, files = trace_report.load_events(trace_dir)
+    rows, wall_ms = trace_report.summarize(events)
+    trace_report.print_table(rows, wall_ms, len(files))
+
+    print(f"\ntrace files:   {trace_dir}/trace_*.json "
+          "(load in https://ui.perfetto.dev)")
+    for stage in ("xe", "cst"):
+        print(f"telemetry:     {ckpt}/{stage}/telemetry.json + "
+              f"{ckpt}/{stage}/metrics.jsonl")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Summarize a ``--trace_dir`` of Chrome-trace JSON into a per-phase table.
+
+The trainer's span tracer (cst_captioning_tpu/telemetry/spans.py) writes
+``trace_*.json`` files; this reads every one in the directory, aggregates
+the complete ("ph": "X") events by span name, and prints where the host
+wall-time went — count, total, mean, p50/p95/max, and share of the traced
+wall span.  The same files load graphically in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing; this is the terminal view.
+
+Usage:
+  python scripts/trace_report.py --trace_dir /tmp/run/trace [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(trace_dir: str):
+    """Every complete span event from every trace_*.json part file."""
+    events = []
+    files = sorted(glob.glob(os.path.join(trace_dir, "*.json")))
+    for path in files:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trace_report: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        for ev in doc.get("traceEvents", doc if isinstance(doc, list) else []):
+            if ev.get("ph") == "X" and "dur" in ev:
+                events.append(ev)
+    return events, files
+
+
+def percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    ix = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[ix]
+
+
+def summarize(events):
+    """-> (rows sorted by total desc, wall_ms).  Durations in ms."""
+    by_name = {}
+    t_lo, t_hi = None, None
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev["dur"] / 1e3)
+        ts, end = ev["ts"], ev["ts"] + ev["dur"]
+        t_lo = ts if t_lo is None else min(t_lo, ts)
+        t_hi = end if t_hi is None else max(t_hi, end)
+    wall_ms = 0.0 if t_lo is None else (t_hi - t_lo) / 1e3
+    rows = []
+    for name, durs in by_name.items():
+        durs.sort()
+        total = sum(durs)
+        rows.append({
+            "span": name,
+            "count": len(durs),
+            "total_ms": round(total, 3),
+            "mean_ms": round(total / len(durs), 3),
+            "p50_ms": round(percentile(durs, 0.50), 3),
+            "p95_ms": round(percentile(durs, 0.95), 3),
+            "max_ms": round(durs[-1], 3),
+            "pct_of_wall": round(100.0 * total / wall_ms, 1) if wall_ms
+                           else 0.0,
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows, wall_ms
+
+
+def print_table(rows, wall_ms: float, nfiles: int) -> None:
+    cols = ("span", "count", "total_ms", "mean_ms", "p50_ms", "p95_ms",
+            "max_ms", "pct_of_wall")
+    widths = {c: max(len(c), *(len(str(r[c])) for r in rows)) if rows
+              else len(c) for c in cols}
+    print(f"trace summary: {nfiles} file(s), traced wall {wall_ms:.1f} ms")
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    print("  ".join("-" * widths[c] for c in cols))
+    for r in rows:
+        print("  ".join(str(r[c]).ljust(widths[c]) for c in cols))
+    if rows:
+        print("\nnote: nested spans overlap (e.g. host-path `score` runs "
+              "inside `compute`), so pct_of_wall columns need not sum "
+              "to 100.")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace_dir", required=True,
+                    help="directory a --trace_dir run wrote trace_*.json to")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary rows as JSON here")
+    args = ap.parse_args()
+
+    events, files = load_events(args.trace_dir)
+    if not files:
+        print(f"trace_report: no trace files under {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    rows, wall_ms = summarize(events)
+    print_table(rows, wall_ms, len(files))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"wall_ms": wall_ms, "files": files, "spans": rows},
+                      f, indent=2)
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,9 @@ def test_default_emits_both_stages():
     # host-path numbers are always reported alongside
     assert out["cst_host_pipeline_captions_per_sec"] > 0
     assert out["cst_serial_captions_per_sec"] > 0
+    # an explicitly-requested CPU run is not a fallback, and no probe ran
+    assert out["cpu_fallback"] is False
+    assert "probe" not in out
 
 
 def test_mfu_fields_in_artifact():
@@ -161,6 +164,14 @@ def test_total_wedge_still_emits_one_json_line():
     assert res["platform"] == "none"
     assert res["child_rc"] == 124
     assert "timed out" in res["error"]
+    # --platform auto probed the backend first: the attempt record (with
+    # per-attempt latency + timeout count) must ride in the artifact even
+    # on this degraded path
+    assert res["probe"]["timeouts"] == 0
+    attempts = res["probe"]["attempts"]
+    assert attempts and attempts[-1]["outcome"] == "ok"
+    assert attempts[-1]["platform"] == "cpu"
+    assert attempts[-1]["latency_s"] > 0
     # the committed BENCH_TPU_CACHE.json holds the last device measurement;
     # when present for this metric it must ride along, self-describing
     cache_path = os.path.join(REPO, "BENCH_TPU_CACHE.json")

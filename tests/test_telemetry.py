@@ -1,0 +1,458 @@
+"""Unified telemetry subsystem (ISSUE 2): span tracer, metrics registry,
+step phases, and the trainer wiring that threads them everywhere.
+
+Fast, hermetic units ride tier-1; the trainer-integration tests drive a
+real in-process Trainer on tiny synthetic fixtures (the
+tests/test_trainer_e2e.py pattern — no subprocess drills)."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.telemetry import (
+    METRICS_SCHEMA,
+    NULL_SPAN,
+    STEP_PHASES,
+    JsonlSink,
+    MetricsRegistry,
+    ScalarWriterSink,
+    SpanTracer,
+    StepPhases,
+    Telemetry,
+    caption_step_flops,
+    mfu_fields,
+    trace_span,
+)
+
+
+def load_trace_events(trace_dir):
+    """All complete-span events from every part file in a trace dir,
+    going through plain json.load — i.e. asserting Chrome-trace validity
+    the same way Perfetto's JSON importer starts."""
+    events = []
+    files = sorted(glob.glob(os.path.join(str(trace_dir), "*.json")))
+    for path in files:
+        doc = json.load(open(path))
+        assert "traceEvents" in doc, f"{path} is not a Chrome trace"
+        events.extend(e for e in doc["traceEvents"] if e.get("ph") == "X")
+    return events, files
+
+
+class TestSpanTracer:
+    def test_nested_spans_export_valid_chrome_trace(self, tmp_path):
+        tr = SpanTracer(str(tmp_path))
+        with tr.span("outer", step=3):
+            with tr.span("inner"):
+                time.sleep(0.01)
+        tr.close()
+        events, files = load_trace_events(tmp_path)
+        assert len(files) == 1
+        by_name = {e["name"]: e for e in events}
+        assert set(by_name) == {"outer", "inner"}
+        outer, inner = by_name["outer"], by_name["inner"]
+        # µs complete events, properly nested on one thread
+        assert outer["tid"] == inner["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+        assert inner["dur"] >= 9_000  # the 10ms sleep, in µs
+        assert outer["args"] == {"step": 3}
+
+    def test_thread_safety_no_lost_spans(self, tmp_path):
+        tr = SpanTracer(str(tmp_path))
+        n_threads, n_spans = 8, 200
+
+        def work(i):
+            for _ in range(n_spans):
+                with tr.span(f"t{i}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tr.close()
+        events, _ = load_trace_events(tmp_path)
+        assert len(events) == n_threads * n_spans
+        # no thread's spans were lost or cross-attributed (tids themselves
+        # can be reused by the OS once a thread exits, so count by name)
+        by_name = {}
+        for e in events:
+            by_name[e["name"]] = by_name.get(e["name"], 0) + 1
+        assert by_name == {f"t{i}": n_spans for i in range(n_threads)}
+
+    def test_rotation_bounds_memory_and_keeps_all_events(self, tmp_path):
+        tr = SpanTracer(str(tmp_path), max_buffered_events=1000)
+        for _ in range(2500):
+            with tr.span("s"):
+                pass
+        tr.close()
+        events, files = load_trace_events(tmp_path)
+        assert len(files) >= 2, "buffer never rotated to a part file"
+        assert len(events) == 2500, "rotation lost events"
+
+    def test_record_after_close_is_dropped_not_raised(self, tmp_path):
+        tr = SpanTracer(str(tmp_path))
+        span = tr.span("late")
+        tr.close()
+        with span:  # a straggler prefetch thread finishing after shutdown
+            pass
+
+    def test_disabled_hook_is_shared_noop(self):
+        # The zero-overhead contract: no tracer -> the ONE shared no-op
+        # object, not a fresh allocation per hook.
+        assert trace_span(None, "x") is NULL_SPAN
+        assert trace_span(None, "y") is NULL_SPAN
+        with trace_span(None, "z"):
+            pass
+
+
+class TestStepPhases:
+    def test_nested_phase_time_is_exclusive(self):
+        ph = StepPhases()
+        with ph.phase("compute"):
+            time.sleep(0.01)
+            with ph.phase("score"):
+                time.sleep(0.03)
+        ms = ph.drain_ms(1)
+        assert ms["score_ms"] >= 25.0
+        # compute excludes the nested score: it must be well under the
+        # combined 40ms, not double-counted.
+        assert ms["compute_ms"] < ms["score_ms"]
+
+    def test_drain_always_emits_canonical_phases_and_resets(self):
+        ph = StepPhases()
+        with ph.phase("data_wait"):
+            pass
+        ms = ph.drain_ms(2)
+        assert set(ms) == {f"{p}_ms" for p in STEP_PHASES}
+        assert ph.drain_ms(1)["data_wait_ms"] == 0.0  # reset
+
+    def test_per_step_mean(self):
+        ph = StepPhases()
+        for _ in range(4):
+            with ph.phase("compute"):
+                time.sleep(0.005)
+        ms = ph.drain_ms(4)
+        assert 3.0 <= ms["compute_ms"] <= 50.0  # ~5ms/step, slop for CI
+
+
+class _FakeSink:
+    def __init__(self):
+        self.records = []
+        self.flushes = []
+        self.closed = False
+
+    def log_step(self, step, scope, metrics, wall_time):
+        self.records.append((step, scope, dict(metrics)))
+
+    def flush(self, fsync=False):
+        self.flushes.append(fsync)
+
+    def close(self):
+        self.closed = True
+
+
+class TestMetricsRegistry:
+    def test_fanout_to_every_sink(self, tmp_path):
+        reg = MetricsRegistry()
+        a, b = _FakeSink(), _FakeSink()
+        reg.add_sink(a)
+        reg.add_sink(b)
+        reg.log_step(3, "train", {"loss": 1.25})
+        reg.flush(fsync=True)
+        assert a.records == b.records == [(3, "train", {"loss": 1.25})]
+        assert a.flushes == [True]
+        reg.close()
+        assert a.closed and b.closed
+
+    def test_jsonl_sink_schema2_records(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        reg = MetricsRegistry()
+        reg.add_sink(JsonlSink(path))
+        reg.log_step(1, "train", {"loss": 2.0})
+        reg.log_step(2, "val", {"CIDEr": 0.5})
+        reg.close()
+        recs = [json.loads(l) for l in open(path)]
+        assert [r["schema"] for r in recs] == [METRICS_SCHEMA] * 2
+        assert recs[0]["scope"] == "train" and recs[0]["loss"] == 2.0
+        assert recs[1]["scope"] == "val" and recs[1]["CIDEr"] == 0.5
+        assert all("time" in r for r in recs)
+
+    def test_counters_gauges_histograms_in_snapshot(self):
+        reg = MetricsRegistry()
+        reg.inc("fault_firings")
+        reg.inc("fault_firings", 2)
+        reg.set_gauge("mfu_pct", 41.5)
+        for v in (1.0, 3.0, 5.0):
+            reg.observe("probe_latency_s", v)
+        snap = reg.snapshot()
+        assert snap["schema"] == METRICS_SCHEMA
+        assert snap["counters"]["fault_firings"] == 3
+        assert snap["gauges"]["mfu_pct"] == 41.5
+        h = snap["histograms"]["probe_latency_s"]
+        assert (h["count"], h["min"], h["max"], h["mean"]) == (3, 1.0, 5.0, 3.0)
+
+    def test_heartbeat_payload_carries_last_step_and_counters(self):
+        reg = MetricsRegistry()
+        reg.inc("divergence_guard_trips")
+        reg.log_step(7, "train", {"loss": 1.0, "data_wait_ms": 0.4})
+        hb = reg.heartbeat_payload()
+        assert hb["last_train"]["step"] == 7
+        assert hb["last_train"]["data_wait_ms"] == 0.4
+        assert hb["counters"]["divergence_guard_trips"] == 1
+
+    def test_write_snapshot(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.inc("checkpoints_saved")
+        path = str(tmp_path / "telemetry.json")
+        reg.write_snapshot(path)
+        assert json.load(open(path))["counters"]["checkpoints_saved"] == 1
+
+    def test_scalarwriter_sink_skips_non_scalars(self):
+        class FakeWriter:
+            def __init__(self):
+                self.scalars = []
+
+            def add_scalar(self, tag, value, step):
+                self.scalars.append((tag, value, step))
+
+        w = FakeWriter()
+        sink = ScalarWriterSink(w)
+        sink.log_step(5, "train", {"loss": 1.0, "mfu_pct": None,
+                                   "flag": True}, 0.0)
+        assert w.scalars == [("train/loss", 1.0, 5)]
+
+    def test_thread_safe_counters(self):
+        reg = MetricsRegistry()
+
+        def work():
+            for _ in range(1000):
+                reg.inc("n")
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counter("n") == 8000
+
+
+class TestTelemetryFacade:
+    def test_defaults_are_fully_disarmed(self):
+        from cst_captioning_tpu.opts import parse_opts
+
+        tel = Telemetry.from_opts(parse_opts([]))
+        assert tel.tracer is None and tel.phases is None
+        # every hook resolves to the shared no-op: nothing to allocate
+        assert tel.span("x") is NULL_SPAN
+        assert tel.phase("x") is NULL_SPAN
+
+    def test_trace_dir_arms_tracer_and_phases(self, tmp_path):
+        from cst_captioning_tpu.opts import parse_opts
+
+        tel = Telemetry.from_opts(
+            parse_opts(["--trace_dir", str(tmp_path / "tr")]))
+        assert tel.tracer is not None and tel.phases is not None
+        tel.close()
+
+    def test_step_timing_alone_arms_phases_without_tracing(self):
+        from cst_captioning_tpu.opts import parse_opts
+
+        tel = Telemetry.from_opts(parse_opts(["--step_timing", "1"]))
+        assert tel.tracer is None and tel.phases is not None
+
+    def test_close_idempotent_and_writes_snapshot(self, tmp_path):
+        tel = Telemetry(tracer=SpanTracer(str(tmp_path / "tr")))
+        tel.inc("fault_firings")
+        snap = str(tmp_path / "telemetry.json")
+        tel.snapshot_path = snap
+        tel.close()
+        tel.close()  # idempotent (atexit + finally double cover)
+        assert json.load(open(snap))["counters"]["fault_firings"] == 1
+
+
+class TestScalarWriterLifecycle:
+    def test_tolerates_use_after_close(self, tmp_path):
+        pytest.importorskip("tensorboard")
+        from cst_captioning_tpu.utils.tb import ScalarWriter
+
+        with ScalarWriter(str(tmp_path)) as w:
+            w.add_scalar("train/loss", 1.0, 1)
+        # closed by the context manager: all of these must be no-ops
+        w.add_scalar("train/loss", 2.0, 2)
+        w.flush()
+        w.close()
+
+
+class TestResilienceCounters:
+    def test_fault_plan_counts_firings(self):
+        from cst_captioning_tpu.resilience.faults import FaultPlan
+
+        reg = MetricsRegistry()
+        plan = FaultPlan.parse("nan_grad@step=5*2").bind_metrics(reg)
+        assert plan.fire("nan_grad", 5)
+        assert not plan.fire("nan_grad", 5)  # replay: consumed, not counted
+        assert plan.fire("nan_grad", 6)
+        assert reg.counter("fault_firings") == 2
+        assert reg.counter("fault_nan_grad") == 2
+
+    def test_guard_counts_trips_and_rollbacks(self):
+        from cst_captioning_tpu.resilience.guard import DivergenceGuard
+
+        reg = MetricsRegistry()
+        g = DivergenceGuard(max_bad=2, max_rollbacks=2, lag=0, metrics=reg)
+        g.observe(0, np.asarray(1.0))
+        g.observe(1, np.asarray(1.0))
+        assert g.poll()
+        g.note_rollback()
+        assert reg.counter("divergence_guard_trips") == 2
+        assert reg.counter("divergence_guard_rollbacks") == 1
+
+    def test_loader_retries_counted(self):
+        from cst_captioning_tpu.data.loader import prefetch_to_device
+        from test_resilience import _FlakySource
+
+        tel = Telemetry()
+        it = prefetch_to_device(_FlakySource(fail_times=2), size=1,
+                                retries=3, retry_backoff_s=0.001,
+                                telemetry=tel)
+        next(it)
+        it.close()
+        assert tel.registry.counter("loader_retries") == 2
+
+
+# -- trainer integration (in-process, tiny synthetic fixtures) -------------
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+
+    root = str(tmp_path_factory.mktemp("telemetry"))
+    spec = SyntheticSpec(num_videos=4, captions_per_video=4, max_len=10,
+                         feat_dims=(12, 6), feat_times=(3, 1))
+    return generate(root, "train", spec)
+
+
+def run_trainer(data, ckpt_dir, **over):
+    from cst_captioning_tpu.opts import parse_opts
+    from cst_captioning_tpu.training.trainer import Trainer
+
+    args = {
+        "--train_feat_h5": json.loads(data["feat_h5"]),
+        "--train_label_h5": [data["label_h5"]],
+        "--train_info_json": [data["info_json"]],
+        "--train_cocofmt_file": [data["cocofmt_json"]],
+        "--checkpoint_path": [ckpt_dir],
+        "--batch_size": ["2"], "--seq_per_img": ["2"],
+        "--rnn_size": ["16"], "--input_encoding_size": ["16"],
+        "--att_size": ["16"], "--drop_prob": ["0.0"],
+        "--max_epochs": ["2"], "--learning_rate": ["0.01"],
+        "--max_length": ["10"], "--log_every": ["1"], "--seed": ["0"],
+    }
+    args.update({k: [str(x) for x in v] for k, v in over.items()})
+    flat = []
+    for k, vals in args.items():
+        flat.append(k)
+        flat.extend(vals)
+    trainer = Trainer(parse_opts(flat))
+    try:
+        trainer.train()
+    finally:
+        trainer.close()
+    return trainer
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_traced_chaos_run_produces_full_telemetry(data, tmp_path):
+    """The acceptance scenario, in-process: a traced XE run with an
+    injected nan_grad fault must leave (a) a loadable Chrome trace with
+    the step-phase spans, (b) schema-2 metrics.jsonl records carrying
+    per-phase *_ms + mfu fields, (c) an exit telemetry.json whose
+    counters show the guard tripping, and (d) a heartbeat file enriched
+    from the registry."""
+    ck = str(tmp_path / "xe")
+    trace = str(tmp_path / "trace")
+    run_trainer(data, ck, **{"--trace_dir": [trace],
+                             "--fault_plan": ["nan_grad@step=1"],
+                             "--wedge_timeout": ["300"]})
+
+    # (a) Chrome trace loads and has the phase + component spans
+    events, files = load_trace_events(trace)
+    names = {e["name"] for e in events}
+    assert {"data_wait", "compute", "ckpt", "ckpt_commit",
+            "prefetch_assemble"} <= names, names
+
+    # (b) metrics.jsonl: schema 2 with phase gauges + mfu fields
+    recs = [json.loads(l) for l in open(os.path.join(ck, "metrics.jsonl"))]
+    train_recs = [r for r in recs if r["scope"] == "train"]
+    assert train_recs, "no train records"
+    assert all(r["schema"] == 2 for r in recs)
+    gauged = [r for r in train_recs if "data_wait_ms" in r]
+    assert gauged, "phase gauges never reached metrics.jsonl"
+    for key in ("data_wait_ms", "compute_ms", "score_ms", "ckpt_ms",
+                "mfu_pct", "achieved_tflops"):
+        assert key in gauged[-1], f"missing {key}"
+    assert gauged[-1]["mfu_pct"] is None  # CPU: no TPU peak to compare to
+
+    # (c) exit snapshot: the drill is auditable
+    tel = json.load(open(os.path.join(ck, "telemetry.json")))
+    assert tel["counters"]["divergence_guard_trips"] >= 1
+    assert tel["counters"]["fault_firings"] == 1
+    assert tel["counters"]["fault_nan_grad"] == 1
+    assert tel["counters"]["checkpoints_saved"] >= 1
+
+    # (d) heartbeat: written by the armed watchdog, registry-enriched
+    hb = json.load(open(os.path.join(ck, "heartbeat.json")))
+    assert hb["pid"] == os.getpid()
+    assert hb["counters"]["fault_firings"] == 1
+    assert hb["last_train"]["step"] >= 1
+
+
+@pytest.mark.e2e
+def test_traced_cst_host_run_shows_score_phase(data, tmp_path):
+    """Host-reward CST is the path with a real host scoring gap: the
+    trace must show `score` (inside the RewardComputer) and `fetch_wait`
+    (the pipeline's device fetch), and the score_ms gauge must be
+    nonzero in at least one logged interval."""
+    ck = str(tmp_path / "cst")
+    trace = str(tmp_path / "trace")
+    run_trainer(data, ck, **{"--trace_dir": [trace],
+                             "--use_rl": ["1"],
+                             "--rl_baseline": ["greedy"],
+                             "--device_rewards": ["0"],
+                             "--overlap_rewards": ["1"],
+                             "--max_epochs": ["1"],
+                             "--learning_rate": ["0.0005"]})
+    events, _ = load_trace_events(trace)
+    names = {e["name"] for e in events}
+    assert {"score", "fetch_wait", "compute", "data_wait"} <= names, names
+    recs = [json.loads(l) for l in open(os.path.join(ck, "metrics.jsonl"))]
+    score_ms = [r.get("score_ms") for r in recs
+                if r["scope"] == "train" and "score_ms" in r]
+    assert score_ms and max(score_ms) > 0.0, score_ms
+
+
+@pytest.mark.e2e
+def test_untraced_run_has_zero_telemetry_surface(data, tmp_path):
+    """Telemetry flags unset: no tracer, no phase timer (the loop hooks
+    reduce to one is-None check), no trace files, no *_ms keys — but the
+    registry still exists, metrics.jsonl is schema 2, and the exit
+    telemetry.json still records counters."""
+    ck = str(tmp_path / "plain")
+    trainer = run_trainer(data, ck)
+    assert trainer._telemetry.tracer is None
+    assert trainer._telemetry.phases is None
+    recs = [json.loads(l) for l in open(os.path.join(ck, "metrics.jsonl"))]
+    assert all(r["schema"] == 2 for r in recs)
+    assert not any("data_wait_ms" in r for r in recs)
+    tel = json.load(open(os.path.join(ck, "telemetry.json")))
+    assert tel["counters"].get("divergence_guard_trips", 0) == 0
+    assert tel["counters"]["checkpoints_saved"] >= 1

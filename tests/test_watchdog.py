@@ -125,6 +125,77 @@ class TestProgressWatchdog:
                 "rearmed after the handler returned")
 
 
+class TestHeartbeatFile:
+    """Watchdog heartbeat writes go through the telemetry registry
+    (ISSUE 2 satellite): the file carries liveness PLUS the last-step
+    phase timings and resilience counters an external harness wants."""
+
+    def test_heartbeat_carries_registry_payload(self, tmp_path):
+        from cst_captioning_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.inc("divergence_guard_trips", 2)
+        reg.log_step(7, "train", {"loss": 1.5, "data_wait_ms": 0.3,
+                                  "compute_ms": 12.5})
+        hb = tmp_path / "ck" / "heartbeat.json"  # dir does not exist yet
+        wd = ProgressWatchdog(30.0, heartbeat_path=str(hb),
+                              payload=reg.heartbeat_payload)
+        wd.start()
+        try:
+            deadline = time.time() + 10.0
+            while not hb.exists() and time.time() < deadline:
+                time.sleep(0.05)
+            assert hb.exists(), "heartbeat never written at thread start"
+            doc = json.loads(hb.read_text())
+        finally:
+            wd.stop()
+        assert doc["pid"] == os.getpid()
+        assert doc["timeout_s"] == 30.0
+        assert doc["beat_gap_s"] >= 0
+        # the enriched payload: last-step phase timings + counters
+        assert doc["counters"]["divergence_guard_trips"] == 2
+        assert doc["last_train"]["step"] == 7
+        assert doc["last_train"]["compute_ms"] == 12.5
+
+    def test_stop_writes_final_state(self, tmp_path):
+        from cst_captioning_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        hb = tmp_path / "heartbeat.json"
+        wd = ProgressWatchdog(60.0, heartbeat_path=str(hb),
+                              payload=reg.heartbeat_payload)
+        wd.start()
+        # counters that land AFTER the start-of-thread write (the poll is
+        # 15s away) must still reach the file via the stop() flush
+        reg.inc("fault_firings", 3)
+        reg.log_step(9, "train", {"loss": 0.5})
+        wd.stop()
+        doc = json.loads(hb.read_text())
+        assert doc["counters"]["fault_firings"] == 3
+        assert doc["last_train"]["step"] == 9
+
+    def test_payload_errors_never_kill_monitoring(self, tmp_path):
+        fired = []
+        wd = ProgressWatchdog(0.2, on_timeout=lambda g: fired.append(g),
+                              heartbeat_path=str(tmp_path / "hb.json"),
+                              payload=lambda: 1 / 0)
+        wd.start()
+        try:
+            deadline = time.time() + 10.0
+            while not fired and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert fired, "a broken payload callable silenced the watchdog"
+
+    def test_no_heartbeat_path_writes_nothing(self, tmp_path):
+        wd = ProgressWatchdog(0.5, on_timeout=lambda g: None)
+        wd.start()
+        time.sleep(0.1)
+        wd.stop()
+        assert list(tmp_path.iterdir()) == []
+
+
 # Driver for the trainer-wiring test: a real Trainer on a tiny fixture
 # whose validate() wedges forever — the armed --wedge_timeout must kill
 # the process with WEDGE_EXIT_CODE instead of hanging the run.
