@@ -108,8 +108,15 @@ anet_xe:
 	  --model_type transformer --num_tx_layers 4 --num_heads 8 \
 	  --checkpoint_path $(OUT)/$(EXP)_anet_xe
 
+# Shipped-config benchmark.  DECODE_CHUNK/OVERLAP default to the trainer
+# defaults read from opts.py; override to probe alternatives, e.g.
+# `make bench DECODE_CHUNK=0` for the legacy full-length rollout scan.
+DECODE_CHUNK ?=
+OVERLAP      ?=
 bench:
-	$(PY) bench.py
+	$(PY) bench.py \
+	  $(if $(DECODE_CHUNK),--decode_chunk $(DECODE_CHUNK),) \
+	  $(if $(OVERLAP),--overlap_depth $(OVERLAP),)
 
 # -- zero-setup synthetic demo --------------------------------------------
 

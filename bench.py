@@ -177,6 +177,56 @@ def bench_xe(args):
     return args.batch_size * args.seq_per_img * args.steps / dt
 
 
+def rollout_step_probe(model, state, feats, args, decode_chunk: int) -> dict:
+    """Early-exit accounting probe (NOT a throughput number): how many
+    decode steps does the rollout actually execute under --decode_chunk,
+    versus the legacy full-length scan's unconditional ``seq_len``?
+
+    The bench model is untrained, so its multinomial rollout essentially
+    never draws EOS and early exit cannot fire on the throughput loops
+    above.  A CONVERGED captioning policy terminates nearly every caption
+    in ~7-10 of the 30 steps (PARITY.md length evidence) — the probe
+    simulates exactly that by biasing the vocab head's EOS logit
+    (``--probe_eos_bias``) so the whole batch terminates early, then
+    reports the executed-step counter the chunked scan returns alongside
+    the sampled-length histogram, so the saving can be read against the
+    lengths that produced it.  Runs once, untimed; the throughput numbers
+    in this JSON are unaffected.
+    """
+    import jax
+    import numpy as np
+
+    from cst_captioning_tpu.ops.losses import sequence_mask
+    from cst_captioning_tpu.ops.sampling import sample_with_baseline
+
+    params = {**state.params}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = (
+        params["logit"]["bias"].at[0].add(args.probe_eos_bias))
+
+    def probe(params, feats, rng, chunk):
+        sampled, _, _, steps = sample_with_baseline(
+            model, {"params": params}, feats, rng, args.seq_len,
+            args.seq_per_img, decode_chunk=chunk, return_steps=True,
+        )
+        return sampled, steps
+
+    rng = jax.random.PRNGKey(777)
+    sampled, steps = jax.jit(probe, static_argnums=(3,))(
+        params, feats, rng, decode_chunk)
+    lens = np.asarray(sequence_mask(sampled).sum(axis=1))
+    executed = int(steps)
+    return {
+        "eos_bias": args.probe_eos_bias,
+        "steps_legacy": args.seq_len,
+        "steps_executed": executed,
+        "steps_saved_pct": round(100.0 * (1 - executed / args.seq_len), 1),
+        "len_mean": round(float(lens.mean()), 2),
+        "len_p50": float(np.percentile(lens, 50)),
+        "len_max": float(lens.max()),
+    }
+
+
 def bench_cst(args):
     """Full CST iteration throughput in the SHIPPED trainer configuration.
 
@@ -188,10 +238,15 @@ def bench_cst(args):
     measured and reported alongside — and becomes the headline when
     --device_rewards 0 is passed or the fused path cannot execute on this
     backend (then labeled ``cst_path: host_pipeline_fallback``).
+
+    All rollouts honor --decode_chunk (default = the trainer's shipped
+    opts.DEFAULT_DECODE_CHUNK): the early-exit chunked scan, whose
+    executed-step savings are reported by ``rollout_step_probe``.
     """
     import jax
 
     from cst_captioning_tpu.opts import (
+        DEFAULT_DECODE_CHUNK,
         DEFAULT_DEVICE_REWARDS,
         DEFAULT_OVERLAP_REWARDS,
     )
@@ -210,8 +265,11 @@ def bench_cst(args):
         native=bool(args.native_cider),
     )
     ncaps = args.batch_size * args.seq_per_img
+    dc = (args.decode_chunk if args.decode_chunk is not None
+          else DEFAULT_DECODE_CHUNK)
 
-    rollout = jax.jit(make_rollout_fused(model, args.seq_len, args.seq_per_img))
+    rollout = jax.jit(make_rollout_fused(model, args.seq_len,
+                                         args.seq_per_img, decode_chunk=dc))
     rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
                       donate_argnums=(0,))
     depth = (args.overlap_depth if args.overlap_depth is not None
@@ -253,7 +311,7 @@ def bench_cst(args):
 
     corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
     step_fn = make_fused_cst_step(model, args.seq_len, args.seq_per_img,
-                                  corpus, tables)
+                                  corpus, tables, decode_chunk=dc)
     fused = jax.jit(step_fn, donate_argnums=(0,))
     vix = np.arange(args.batch_size, dtype=np.int32)
     # Trace OUTSIDE the try: a code regression in the fused step fails
@@ -284,6 +342,15 @@ def bench_cst(args):
               "pipeline (cst_path=host_pipeline_fallback)", file=sys.stderr)
     else:
         value, path = overlapped, "host_pipeline"
+    # Early-exit step accounting (untimed; see rollout_step_probe).  A
+    # probe failure must not sink the measured throughput above.
+    probe = None
+    if dc > 0:
+        try:
+            probe = rollout_step_probe(model, state, feats, args, dc)
+        except Exception as e:
+            print(f"bench: rollout step probe failed ({e!r}); "
+                  "reporting rollout_probe=null", file=sys.stderr)
     return {
         "value": value,
         "path": path,
@@ -293,6 +360,8 @@ def bench_cst(args):
             None if fused_cps is None else round(fused_cps, 1),
         "overlap_depth": depth,
         "scorer": scorer_kind,
+        "decode_chunk": dc,
+        "rollout_probe": probe,
     }
 
 
@@ -321,6 +390,16 @@ def parse_args():
                         "measured and reported either way")
     p.add_argument("--native_cider", type=int, default=1,
                    help="1 = C++ reward scorer (trainer default)")
+    p.add_argument("--decode_chunk", type=int, default=None,
+                   help="early-exit rollout chunk for the CST stage; "
+                        "default = the trainer's --decode_chunk default "
+                        "(read from opts.py); 0 benches the legacy "
+                        "full-length scan")
+    p.add_argument("--probe_eos_bias", type=float, default=10.0,
+                   help="EOS-logit bias for the rollout step-count probe "
+                        "(simulates a converged policy's early "
+                        "termination; see rollout_step_probe).  Does not "
+                        "affect the measured throughput numbers")
     p.add_argument("--cache", type=int, default=1,
                    help="0 = do not persist this run to BENCH_TPU_CACHE "
                         "(exploratory configs must not clobber the "
@@ -369,6 +448,7 @@ def resolved_config(args) -> dict:
     length, not what is measured — and the CPU fallback trims it (see
     run_measurement) without forfeiting the cache attach."""
     from cst_captioning_tpu.opts import (
+        DEFAULT_DECODE_CHUNK,
         DEFAULT_DEVICE_REWARDS,
         DEFAULT_OVERLAP_REWARDS,
         DEFAULT_REMAT_CELL,
@@ -377,11 +457,14 @@ def resolved_config(args) -> dict:
 
     config = {k: getattr(args, k) for k in
               ("batch_size", "seq_per_img", "seq_len", "vocab", "hidden",
-               "bfloat16", "native_cider", "overlap_depth", "device_rewards")}
+               "bfloat16", "native_cider", "overlap_depth", "device_rewards",
+               "decode_chunk")}
     if config["overlap_depth"] is None:
         config["overlap_depth"] = DEFAULT_OVERLAP_REWARDS
     if config["device_rewards"] is None:
         config["device_rewards"] = DEFAULT_DEVICE_REWARDS
+    if config["decode_chunk"] is None:
+        config["decode_chunk"] = DEFAULT_DECODE_CHUNK
     # build() bakes these model-level defaults into the measured program,
     # so they are part of the configuration identity too.
     config["scan_unroll"] = DEFAULT_SCAN_UNROLL
@@ -520,6 +603,8 @@ def run_measurement(args) -> None:
         "cst_fused_captions_per_sec": cst["fused_captions_per_sec"],
         "cst_overlap_depth": cst["overlap_depth"],
         "cst_scorer": cst["scorer"],
+        "cst_decode_chunk": cst["decode_chunk"],
+        "cst_rollout_probe": cst["rollout_probe"],
         **{f"xe_{k}": v for k, v in xe_mfu.items()},
         **{f"cst_{k}": v for k, v in cst_mfu.items()},
     }, args)

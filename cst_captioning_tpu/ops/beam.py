@@ -64,12 +64,24 @@ def beam_search_tokens(
     beam_size: int,
     max_len: int,
     length_norm: float = 0.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    decode_chunk: int = 0,
+    return_steps: bool = False,
+):
     """Run beam search over a bound decode ``step``.
 
     ``init_carry`` must already be expanded to ``B*k`` rows (use
     ``_expand_to_beams``).  Returns (best (B, L), all_beams (B, k, L),
-    scores (B, k)) with beams sorted best-first.
+    scores (B, k)) with beams sorted best-first; with ``return_steps=True``
+    also an int32 scalar of decode steps actually executed.
+
+    ``decode_chunk`` > 0 is the early-exit fast path: a ``lax.while_loop``
+    over fixed-size scan chunks with an all-beams-finished predicate.  An
+    all-finished legacy step is a provable no-op that extends every beam
+    with EOS at parent=identity (scores descending from the previous
+    ``top_k``, EOS at cost 0 beats every non-EOS at NEG_INF, ties broken
+    toward lower flat index = lower parent) — so pre-filling the skipped
+    steps' buffers with token 0 / parent identity reproduces the legacy
+    backtrack bit-exactly (pinned by tests/test_decode_fastpath.py).
     """
     k = beam_size
 
@@ -104,9 +116,50 @@ def beam_search_tokens(
         jnp.zeros((batch, k), dtype=bool),
         jnp.zeros((batch, k), dtype=jnp.int32),
     )
-    (_, _, scores, _, lengths), (tokens, parents) = jax.lax.scan(
-        body, init, jnp.arange(max_len)
-    )
+    if decode_chunk <= 0 or decode_chunk >= max_len:
+        (_, _, scores, _, lengths), (tokens, parents) = jax.lax.scan(
+            body, init, jnp.arange(max_len)
+        )
+        steps_executed = jnp.int32(max_len)
+    else:
+        chunk = int(decode_chunk)
+        padded = -(-max_len // chunk) * chunk
+        step_ix = jnp.arange(padded)
+
+        def body_clamped(state, t):
+            # The last chunk can overrun max_len; unlike the sampler
+            # (whose overrun outputs are sliced off), beam scores/lengths
+            # live in the CARRY, so overrun steps must be the all-finished
+            # no-op step — forcing finished makes every beam extend with
+            # EOS at cost 0 (scores, lengths, order all unchanged).
+            carry, prev, scores, finished, lengths = state
+            state = (carry, prev, scores, finished | (t >= max_len), lengths)
+            return body(state, t)
+
+        def chunk_body(loop):
+            t, state, toks, pars = loop
+            ts = jax.lax.dynamic_slice_in_dim(step_ix, t, chunk, axis=0)
+            state, (ctoks, cpars) = jax.lax.scan(body_clamped, state, ts)
+            toks = jax.lax.dynamic_update_slice_in_dim(toks, ctoks, t, axis=0)
+            pars = jax.lax.dynamic_update_slice_in_dim(pars, cpars, t, axis=0)
+            return t + chunk, state, toks, pars
+
+        def chunk_cond(loop):
+            t, state, _, _ = loop
+            return (t < max_len) & ~jnp.all(state[3])
+
+        # Skipped steps pre-filled with the all-finished step's provable
+        # output: token 0, parent identity (docstring above).
+        ident = jnp.broadcast_to(jnp.arange(k)[None, None, :],
+                                 (padded, batch, k))
+        t_end, state, tokens, parents = jax.lax.while_loop(
+            chunk_cond, chunk_body,
+            (jnp.int32(0), init,
+             jnp.zeros((padded, batch, k), jnp.int32), ident),
+        )
+        scores, lengths = state[2], state[4]
+        tokens, parents = tokens[:max_len], parents[:max_len]
+        steps_executed = jnp.minimum(t_end, max_len)
     # Backtrack (L, B, k) token/parent chains into (B, k, L) sequences.
     def back(beam_ix, tp):                                     # beam_ix (B, k)
         tok_t, par_t = tp                                      # each (B, k)
@@ -125,7 +178,8 @@ def beam_search_tokens(
     order = jnp.argsort(-ranked, axis=1)
     seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
     ranked = jnp.take_along_axis(ranked, order, axis=1)
-    return seqs[:, 0, :], seqs, ranked
+    out = (seqs[:, 0, :], seqs, ranked)
+    return out + (steps_executed,) if return_steps else out
 
 
 def beam_search(
@@ -135,6 +189,7 @@ def beam_search(
     beam_size: int,
     max_len: int,
     length_norm: float = 0.0,
+    decode_chunk: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Encode + beam-decode a batch of videos.
 
@@ -152,16 +207,18 @@ def beam_search(
     )
     step = make_decode_step(model, variables, memory, proj_mem, pooled)
     return beam_search_tokens(step, carry, batch, beam_size, max_len,
-                              length_norm=length_norm)
+                              length_norm=length_norm,
+                              decode_chunk=decode_chunk)
 
 
 def jit_beam_search(model, beam_size: int, max_len: int,
-                    length_norm: float = 0.0):
+                    length_norm: float = 0.0, decode_chunk: int = 0):
     """jit-compiled beam search: (variables, feats) -> (best, beams, scores)."""
 
     @jax.jit
     def fn(variables, feats):
         return beam_search(model, variables, feats, beam_size, max_len,
-                           length_norm=length_norm)
+                           length_norm=length_norm,
+                           decode_chunk=decode_chunk)
 
     return fn

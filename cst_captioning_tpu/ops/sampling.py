@@ -72,7 +72,9 @@ def sample_tokens(
     greedy=False,
     temperature: float = 1.0,
     unroll: int = 1,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    decode_chunk: int = 0,
+    return_steps: bool = False,
+):
     """Roll out ``max_len`` steps from BOS (=0).
 
     ``greedy`` is either a python bool (whole batch) or a per-row (N,) bool
@@ -83,8 +85,22 @@ def sample_tokens(
     ``models.decoder_lstm.scan_decoder``: same numerics, amortized
     per-step overhead for small per-step matmuls).
 
+    ``decode_chunk`` > 0 enables the early-exit fast path: the rollout
+    runs as a ``lax.while_loop`` over fixed-size scan chunks of that many
+    steps, stopping once EVERY row has emitted its EOS — a batch whose
+    captions end at step 9 pays for 2 chunks of 8, not all 30 steps.  The
+    inner chunk stays a fused ``lax.scan`` so the TPU keeps its
+    pipelining, the per-step computation (keys included) is exactly the
+    legacy scan's, and the skipped steps' outputs are the zeros the
+    legacy path would have emitted for finished rows — so the outputs are
+    BIT-IDENTICAL to ``decode_chunk=0`` (pinned by
+    tests/test_decode_fastpath.py).  0 = legacy single full-length scan.
+
     Returns (tokens (N, L) int32 0-terminated, logprobs (N, L) float32 of
-    the emitted tokens, 0 past the first EOS).
+    the emitted tokens, 0 past the first EOS); with ``return_steps=True``
+    also an int32 scalar of decode steps actually executed (== max_len on
+    the legacy path, a multiple of ``decode_chunk`` capped at max_len on
+    the early-exit path).
     """
     per_row = not isinstance(greedy, bool)
 
@@ -114,8 +130,49 @@ def sample_tokens(
         jnp.zeros((batch,), dtype=jnp.int32),        # BOS
         jnp.zeros((batch,), dtype=bool),
     )
-    _, (tokens, logprobs) = jax.lax.scan(body, init, keys, unroll=unroll)
-    return tokens.T, logprobs.T                       # (L, N) -> (N, L)
+    if decode_chunk <= 0 or decode_chunk >= max_len:
+        _, (tokens, logprobs) = jax.lax.scan(body, init, keys, unroll=unroll)
+        out = (tokens.T, logprobs.T)                  # (L, N) -> (N, L)
+        return out + (jnp.int32(max_len),) if return_steps else out
+
+    chunk = int(decode_chunk)
+    n_chunks = -(-max_len // chunk)
+    padded = n_chunks * chunk
+    if padded > max_len:
+        # The final chunk's trailing steps run but land past max_len in
+        # the padded buffers and are sliced off below (their extra keys
+        # are zeros; nothing they compute feeds an earlier position).
+        keys = jnp.concatenate(
+            [keys, jnp.zeros((padded - max_len,) + keys.shape[1:],
+                             keys.dtype)], axis=0)
+
+    def chunk_body(loop):
+        t, state, toks, logps = loop
+        ks = jax.lax.dynamic_slice_in_dim(keys, t, chunk, axis=0)
+        state, (ctoks, clogps) = jax.lax.scan(body, state, ks, unroll=unroll)
+        # In-place carry updates: XLA aliases while-loop carries, so the
+        # (L, N) buffers are written, never copied.
+        toks = jax.lax.dynamic_update_slice_in_dim(toks, ctoks, t, axis=0)
+        logps = jax.lax.dynamic_update_slice_in_dim(logps, clogps, t, axis=0)
+        return t + chunk, state, toks, logps
+
+    def chunk_cond(loop):
+        t, state, _, _ = loop
+        return (t < max_len) & ~jnp.all(state[2])
+
+    # Output buffers must match the legacy scan's stacked dtypes exactly
+    # (bf16 models emit bf16 logprobs) — derive them without running.
+    _, (tok_aval, logp_aval) = jax.eval_shape(body, init, keys[0])
+    t_end, _, tokens, logprobs = jax.lax.while_loop(
+        chunk_cond, chunk_body,
+        (jnp.int32(0), init,
+         jnp.zeros((padded, batch), tok_aval.dtype),
+         jnp.zeros((padded, batch), logp_aval.dtype)),
+    )
+    out = (tokens[:max_len].T, logprobs[:max_len].T)
+    if return_steps:
+        return out + (jnp.minimum(t_end, max_len),)
+    return out
 
 
 def sample_captions(
@@ -127,12 +184,15 @@ def sample_captions(
     seq_per_img: int = 1,
     greedy: bool = False,
     temperature: float = 1.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    decode_chunk: int = 0,
+    return_steps: bool = False,
+):
     """Encode once, roll out ``seq_per_img`` captions per video.
 
     -> (tokens (B*seq_per_img, L), logprobs (B*seq_per_img, L)).
     Greedy rollouts with seq_per_img>1 are identical per video (used with
-    seq_per_img=1 for the SCST baseline / eval decode).
+    seq_per_img=1 for the SCST baseline / eval decode).  ``decode_chunk``
+    / ``return_steps``: see ``sample_tokens`` (early-exit fast path).
     """
     memory, proj_mem, pooled = model.apply(
         variables, feats, method="encode"
@@ -147,7 +207,9 @@ def sample_captions(
     step = make_decode_step(model, variables, memory, proj_mem, pooled)
     return sample_tokens(step, carry, n, max_len, rng,
                          greedy=greedy, temperature=temperature,
-                         unroll=getattr(model, "scan_unroll", 1))
+                         unroll=getattr(model, "scan_unroll", 1),
+                         decode_chunk=decode_chunk,
+                         return_steps=return_steps)
 
 
 def sample_with_baseline(
@@ -158,7 +220,9 @@ def sample_with_baseline(
     max_len: int,
     seq_per_img: int,
     temperature: float = 1.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    decode_chunk: int = 0,
+    return_steps: bool = False,
+):
     """Multinomial rollout + greedy SCST baseline in ONE fused scan.
 
     The CST iteration needs both the (B*S) policy samples and the (B)
@@ -167,7 +231,9 @@ def sample_with_baseline(
     is latency- not FLOP-bound on TPU); concatenating the greedy rows onto
     the sampled rows and flag-selecting argmax per row halves it.
 
-    -> (sampled (B*S, L), sampled_logprobs (B*S, L), greedy (B, L)).
+    -> (sampled (B*S, L), sampled_logprobs (B*S, L), greedy (B, L)), plus
+    an executed-step scalar when ``return_steps`` (see ``sample_tokens``;
+    the early-exit predicate requires sampled AND greedy rows finished).
     """
     memory, proj_mem, pooled = model.apply(variables, feats, method="encode")
     b = pooled.shape[0]
@@ -181,25 +247,31 @@ def sample_with_baseline(
     carry = model.apply(variables, pooled, max_len, method="init_carry")
     step = make_decode_step(model, variables, memory, proj_mem, pooled)
     greedy_rows = jnp.arange(ns + b) >= ns
-    tokens, logprobs = sample_tokens(
+    out = sample_tokens(
         step, carry, ns + b, max_len, rng,
         greedy=greedy_rows, temperature=temperature,
         unroll=getattr(model, "scan_unroll", 1),
+        decode_chunk=decode_chunk, return_steps=return_steps,
     )
-    return tokens[:ns], logprobs[:ns], tokens[ns:]
+    tokens, logprobs = out[:2]
+    res = (tokens[:ns], logprobs[:ns], tokens[ns:])
+    return res + (out[2],) if return_steps else res
 
 
-def greedy_decode(model, variables, feats, max_len: int) -> jnp.ndarray:
+def greedy_decode(model, variables, feats, max_len: int,
+                  decode_chunk: int = 0) -> jnp.ndarray:
     """Deterministic argmax decode -> (B, L) tokens (eval fast path)."""
     tokens, _ = sample_captions(
         model, variables, feats,
         jax.random.PRNGKey(0), max_len, greedy=True,
+        decode_chunk=decode_chunk,
     )
     return tokens
 
 
 def jit_sampler(model, max_len: int, seq_per_img: int = 1,
-                greedy: bool = False, temperature: float = 1.0):
+                greedy: bool = False, temperature: float = 1.0,
+                decode_chunk: int = 0):
     """jit-compiled sampler: (variables, feats, rng) -> (tokens, logprobs)."""
 
     @jax.jit
@@ -207,6 +279,7 @@ def jit_sampler(model, max_len: int, seq_per_img: int = 1,
         return sample_captions(
             model, variables, feats, rng, max_len,
             seq_per_img=seq_per_img, greedy=greedy, temperature=temperature,
+            decode_chunk=decode_chunk,
         )
 
     return fn

@@ -153,7 +153,23 @@ def _add_optim_args(p: argparse.ArgumentParser) -> None:
 # hardware (PARITY.md measurement table).  --device_rewards 0 selects the
 # host reward path, whose pipeline depth is DEFAULT_OVERLAP_REWARDS.
 DEFAULT_DEVICE_REWARDS = 1
-DEFAULT_OVERLAP_REWARDS = 1
+
+# Host-path reward-pipeline depth (--overlap_rewards).  2, not 1: every
+# in-flight rollout's fetch starts its device->host copy at dispatch
+# (pipeline.py copy_to_host_async), so depth 2 double-buffers the copies —
+# step t's transfer+scoring hides behind rollouts t+1 AND t+2, which is
+# what the measured tunnel numbers need (~60ms RTT + ~20ms scoring vs
+# ~43ms device work: one step of overlap cannot cover the gap; two can).
+# Staleness grows to <= 2 updates (stale-sample REINFORCE, PARITY.md).
+DEFAULT_OVERLAP_REWARDS = 2
+
+# Rollout early-exit chunk (--decode_chunk).  The sampler/greedy/beam
+# scans stop launching chunks once every row (beam) has emitted EOS;
+# healthy trained captions finish in ~7-10 of the 30 max_len steps, so
+# the fused-scan chunks turn the dominant masked-dead rollout work into
+# skipped work.  Chunked output is bit-identical to the legacy full-length
+# scan (tests/test_decode_fastpath.py); 0 restores the legacy path.
+DEFAULT_DECODE_CHUNK = 8
 
 # Decoder-scan unroll (--scan_unroll): measured on TPU v5 lite
 # (scripts/unroll_probe.py, table in PARITY.md); numerics are identical at
@@ -190,8 +206,13 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                         "(rollout -> reward -> grad serially); k >= 1 "
                         "overlaps the reward of step t with rollouts "
                         "t+1..t+k, making samples up to k updates stale for "
-                        "the grad step (PARITY.md).  Ignored under "
-                        "--device_rewards 1 (nothing to overlap)")
+                        "the grad step (PARITY.md).  Default 2 double-"
+                        "buffers the device->host fetches (each starts "
+                        "async at dispatch), hiding transfer + scoring "
+                        "behind two rollouts; the fetch_wait_ms/score_ms "
+                        "step-phase gauges (--step_timing) show where the "
+                        "overlap lands.  Ignored under --device_rewards 1 "
+                        "(nothing to overlap)")
     g.add_argument("--device_rewards", type=int,
                    default=DEFAULT_DEVICE_REWARDS,
                    help="1 (default) = compute CIDEr-D rewards ON DEVICE and "
@@ -220,6 +241,14 @@ def _add_decode_args(p: argparse.ArgumentParser) -> None:
                    help="maximum decode length")
     g.add_argument("--length_norm", type=float, default=0.0,
                    help="beam score length-normalization exponent; 0 = off")
+    g.add_argument("--decode_chunk", type=int, default=DEFAULT_DECODE_CHUNK,
+                   help="early-exit decode: run rollout/greedy/beam scans "
+                        "as a while-loop over fused scan chunks of this "
+                        "many steps, stopping once every row (every beam) "
+                        "has emitted EOS — a batch whose captions end at "
+                        "step 9 pays 16 steps, not max_length.  Output is "
+                        "bit-identical to the full-length scan at any "
+                        "value; 0 = legacy single full-length scan")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
@@ -303,6 +332,19 @@ def _add_resilience_args(p: argparse.ArgumentParser) -> None:
                    help="rollbacks before the run aborts as unrecoverable "
                         "(a deterministic divergence would otherwise "
                         "replay forever)")
+    g.add_argument("--abort_on_negative_advantage_window", type=int,
+                   default=0,
+                   help="1 = abort the run (train.py exit 4) when the "
+                        "negative-advantage regime detector fires: every "
+                        "logged advantage in the rolling window negative "
+                        "with mean < -0.05 means the baseline dominates "
+                        "the samples and REINFORCE can only suppress "
+                        "typical sequences — an unattended chain should "
+                        "stop and surface the collapsing stage instead of "
+                        "burning its chip window on it (remedies in the "
+                        "abort message: scb-sample baseline, lower "
+                        "temperature/lr).  0 (default) = warn once and "
+                        "continue")
     g.add_argument("--fault_plan", default=None,
                    help="CHAOS TESTING ONLY: comma-separated deterministic "
                         "fault specs injected into this run, e.g. "

@@ -23,6 +23,7 @@ def data_parallel_jit(
     batch_argnums=(1,),
     donate_argnums=(0,),
     out_batch_tree=None,
+    donate_batch: bool = False,
 ) -> Callable:
     """jit ``step_fn`` with DP shardings.
 
@@ -37,9 +38,22 @@ def data_parallel_jit(
         by default ALL outputs are constrained replicated — letting XLA
         choose (out_shardings=None) can leave updated params sharded,
         which would silently break checkpointing and later steps.
+      donate_batch: also donate every ``batch_argnums`` argument.  XLA
+        donation is input->output ALIASING, so this only frees HBM when
+        the program emits a batch-shaped output the input can alias onto
+        (``out_batch_tree`` steps: token transforms, in-place table
+        writes); a donation with no matching output is skipped with a
+        warning and the buffer survives.  The shipped train steps emit
+        only replicated state/metrics, so they donate the state alone
+        (their largest live buffers) and leave this False.  Never set it
+        for callers that replay the same arrays (bench loops) or feed a
+        later program from the same buffer (the rollout's feats, which
+        the grad step still needs).
     """
     b = batch_sharding(mesh)
     r = replicated_sharding(mesh)
+    donated = tuple(donate_argnums) + (
+        tuple(batch_argnums) if donate_batch else ())
     # A single sharding per argument/output broadcasts over its pytree.
     in_sh = lambda n: tuple(
         b if i in batch_argnums else r for i in range(n)
@@ -60,7 +74,7 @@ def data_parallel_jit(
                 step_fn,
                 in_shardings=in_sh(len(args)),
                 out_shardings=out_sh,
-                donate_argnums=donate_argnums,
+                donate_argnums=tuple(i for i in donated if i < len(args)),
             )
             compiled[len(args)] = fn
         return fn(*args)

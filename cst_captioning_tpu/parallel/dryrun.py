@@ -81,11 +81,17 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
     # -- XE steps ----------------------------------------------------------
     xe = data_parallel_jit(make_xe_step(model, S), mesh,
                            batch_argnums=(1, 2, 3), donate_argnums=(0,))
+    # Losses stay ON DEVICE until the single batched fetch in the return
+    # below: per-step float() scalar fetches are the pattern this
+    # session's native CPU stack nondeterministically garbles to 0.0
+    # (RESILIENCE.md — the same reason the trainer's control plane runs
+    # on host-side step integers), and this helper's results are
+    # asserted bit-for-bit by tests/test_real_model_mesh.py.
     xe_losses = []
     for i in range(xe_steps):
         state, metrics = xe(state, feats, labels, weights,
                             jax.random.fold_in(key, i))
-        xe_losses.append(float(metrics["loss"]))
+        xe_losses.append(metrics["loss"])
 
     # -- CST step: device rollout -> host advantage -> device grad ---------
     rollout = data_parallel_jit(
@@ -146,16 +152,26 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
         kv = jax.device_put(kv, time_sharding(sp_mesh))
         qq = jnp.asarray(rng.standard_normal((bq, 4, HIDDEN)), jnp.float32)
         ctx = sp_cross_attention_jit(sp_mesh)(qq, kv, kv)
-        sp_ctx_sum = float(jnp.sum(ctx))
+        sp_ctx_sum = jnp.sum(ctx)
 
+    # One batched device_get of every scalar, not N float() fetches —
+    # see the xe_losses comment above.
+    scalars = jax.device_get({
+        "xe_losses": jnp.stack(xe_losses),
+        "rl_loss": rl_metrics["loss"],
+        "fused_loss": fused_metrics["loss"],
+        "fused_reward": fused_metrics["reward"],
+        "sp_ctx_sum": (jnp.zeros(()) if sp_ctx_sum is None else sp_ctx_sum),
+    })
     return {
         "mesh_shape": dict(mesh.shape),
-        "xe_losses": xe_losses,
+        "xe_losses": [float(v) for v in scalars["xe_losses"]],
         "sampled": sampled_host,
         "greedy": greedy_host,
-        "rl_loss": float(rl_metrics["loss"]),
-        "fused_loss": float(fused_metrics["loss"]),
-        "fused_reward": float(fused_metrics["reward"]),
-        "sp_ctx_sum": sp_ctx_sum,
+        "rl_loss": float(scalars["rl_loss"]),
+        "fused_loss": float(scalars["fused_loss"]),
+        "fused_reward": float(scalars["fused_reward"]),
+        "sp_ctx_sum": (None if sp_ctx_sum is None
+                       else float(scalars["sp_ctx_sum"])),
         "params": jax.device_get(state.params),
     }

@@ -132,7 +132,15 @@ class MetricsRegistry:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(snap, f, indent=2, default=str)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        # The rename is a directory-entry update a host crash can lose
+        # even after the data fsync above; best-effort, same pattern as
+        # checkpoint.py's infos.json.
+        from ..resilience.integrity import fsync_dir
+
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
 
     def close(self) -> None:
         for sink in self._sinks:

@@ -26,17 +26,20 @@ _DECODER_CACHE: dict = {}
 
 
 def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float,
-                      mesh=None):
+                      mesh=None, decode_chunk: int = 0):
     """Compile (and memoize) the greedy/beam decoder; with ``mesh`` the
     batch is sharded over the ``data`` axis so validation/eval decode
     scales with the device count instead of idling every chip but one
-    (VERDICT.md round 2 item 7 / SURVEY §6 config 5)."""
-    key = (model, beam_size, max_len, length_norm, mesh)
+    (VERDICT.md round 2 item 7 / SURVEY §6 config 5).  ``decode_chunk``
+    > 0 = early-exit chunked decode (ops.sampling/ops.beam; bit-identical
+    tokens, fewer executed steps once the whole batch has terminated)."""
+    key = (model, beam_size, max_len, length_norm, mesh, decode_chunk)
     fn = _DECODER_CACHE.get(key)
     if fn is None:
         if beam_size > 1:
             if mesh is None:
-                fn = jit_beam_search(model, beam_size, max_len, length_norm)
+                fn = jit_beam_search(model, beam_size, max_len, length_norm,
+                                     decode_chunk=decode_chunk)
             else:
                 from ..ops.beam import beam_search
                 from ..parallel.dp import data_parallel_jit
@@ -44,19 +47,21 @@ def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float,
                 fn = data_parallel_jit(
                     lambda variables, feats: beam_search(
                         model, variables, feats, beam_size, max_len,
-                        length_norm),
+                        length_norm, decode_chunk=decode_chunk),
                     mesh, batch_argnums=(1,), donate_argnums=(),
                 )
         else:
             if mesh is None:
-                fn = jit_sampler(model, max_len, seq_per_img=1, greedy=True)
+                fn = jit_sampler(model, max_len, seq_per_img=1, greedy=True,
+                                 decode_chunk=decode_chunk)
             else:
                 from ..ops.sampling import sample_captions
                 from ..parallel.dp import data_parallel_jit
 
                 fn = data_parallel_jit(
                     lambda variables, feats, rng: sample_captions(
-                        model, variables, feats, rng, max_len, greedy=True),
+                        model, variables, feats, rng, max_len, greedy=True,
+                        decode_chunk=decode_chunk),
                     mesh, batch_argnums=(1,), donate_argnums=(),
                 )
         _DECODER_CACHE[key] = fn
@@ -66,6 +71,7 @@ def _compiled_decoder(model, beam_size: int, max_len: int, length_norm: float,
 def _decode_local(
     model, params, loader: CaptionLoader, max_len: int,
     beam_size: int, length_norm: float, mesh=None, beat=None,
+    decode_chunk: int = 0,
 ) -> Tuple[List[str], List[np.ndarray]]:
     """Decode THIS host's loader shard -> (video_ids, token rows), deduped
     of the static-shape wrap padding, in shard (dataset) order."""
@@ -80,10 +86,12 @@ def _decode_local(
         mesh = None
     variables = {"params": params}
     if beam_size > 1:
-        beam = _compiled_decoder(model, beam_size, max_len, length_norm, mesh)
+        beam = _compiled_decoder(model, beam_size, max_len, length_norm, mesh,
+                                 decode_chunk)
         decode = lambda feats: beam(variables, feats)[0]
     else:
-        sampler = _compiled_decoder(model, 1, max_len, length_norm, mesh)
+        sampler = _compiled_decoder(model, 1, max_len, length_norm, mesh,
+                                    decode_chunk)
         decode = lambda feats: sampler(variables, feats,
                                        jax.random.PRNGKey(0))[0]
     seen = set()
@@ -162,6 +170,7 @@ def decode_split(
     allgather=None,
     mesh=None,
     beat=None,
+    decode_chunk: int = 0,
 ) -> List[Dict[str, str]]:
     """One ordered pass over the split -> [{"image_id", "caption"}].
 
@@ -175,7 +184,8 @@ def decode_split(
     mistaken for a hang.
     """
     ids, rows = _decode_local(model, params, loader, max_len,
-                              beam_size, length_norm, mesh, beat=beat)
+                              beam_size, length_norm, mesh, beat=beat,
+                              decode_chunk=decode_chunk)
     if loader.process_count > 1:
         ids, rows = gather_strided_predictions(
             np.stack(rows), loader.ds.video_ids,
@@ -197,11 +207,12 @@ def eval_split(
     scorers: Optional[Sequence[str]] = None,
     mesh=None,
     beat=None,
+    decode_chunk: int = 0,
 ) -> Tuple[List[Dict[str, str]], Dict[str, float]]:
     """Decode + score one split -> (predictions, metric dict)."""
     preds = decode_split(model, params, loader, vocab, max_len,
                          beam_size=beam_size, length_norm=length_norm,
-                         mesh=mesh, beat=beat)
+                         mesh=mesh, beat=beat, decode_chunk=decode_chunk)
     if beat is not None:
         beat()  # decode done; host-side scoring gets a fresh full window
     scores = language_eval(preds, refs, scorers=scorers)

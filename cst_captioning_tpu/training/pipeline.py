@@ -41,11 +41,17 @@ class RewardPipeline:
         -> (advantage (N,), stats dict)`` — the RewardComputer call; ``ctx``
         is whatever per-batch payload it needs (video ids).
       depth: rollouts kept in flight (``--overlap_rewards``); 0 = serial.
+        Every in-flight fetch's device->host copy starts asynchronously at
+        dispatch (``copy_to_host_async`` in ``push``), so depth >= 2 keeps
+        the copies double-buffered: by the time step t completes, its
+        fetch has been streaming while rollouts t+1..t+depth ran, and the
+        blocking ``fetch_wait`` shrinks toward zero.
       telemetry: optional ``telemetry.Telemetry`` — the fetch that blocks
-        on the device rollout gets a ``fetch_wait`` host span (the reward
-        compute itself is spanned inside the RewardComputer), making the
-        overlap visible in a ``--trace_dir`` Chrome trace alongside the
-        ``--profile_dir`` TraceAnnotations.  None = one is-None check.
+        on the device rollout gets a ``fetch_wait`` phase+span (surfacing
+        as a ``fetch_wait_ms`` step gauge under ``--step_timing``, next to
+        ``data_wait_ms``/``score_ms``; the reward compute itself is the
+        ``score`` phase inside the RewardComputer), making where the
+        overlap lands visible without a trace.  None = one is-None check.
     """
 
     def __init__(
@@ -87,6 +93,7 @@ class RewardPipeline:
 
     def _complete_one(self, state) -> Tuple[Any, Tuple[Any, Dict[str, float]]]:
         sampled, fetch, feats, step_rng, ctx = self._pending.pop(0)
+        inflight = len(self._pending)  # rollouts still covering this wait
         tel = self._telemetry
         # TraceAnnotations make the host gap legible in a --profile_dir
         # trace: fetch-wait (device + transfer latency) vs reward compute.
@@ -94,7 +101,10 @@ class RewardPipeline:
             if tel is None:
                 fetched = np.asarray(jax.device_get(fetch))
             else:
-                with tel.span("fetch_wait"):
+                # phase, not bare span: surfaces as fetch_wait_ms in the
+                # --step_timing gauges so the overlap's residual blocking
+                # is measurable without loading a trace.
+                with tel.phase("fetch_wait"):
                     fetched = np.asarray(jax.device_get(fetch))
         n = sampled.shape[0]
         greedy_rows = fetched[n:] if fetched.shape[0] > n else None
@@ -105,6 +115,7 @@ class RewardPipeline:
         )
         metrics = dict(metrics)
         metrics.update(stats)
+        metrics["overlap_inflight"] = float(inflight)
         return state, (ctx, metrics)
 
     def drain(self, state) -> Tuple[Any, List[Tuple[Any, Dict[str, float]]]]:

@@ -89,7 +89,8 @@ def make_xe_step(model, seq_per_img: int, guard: bool = False) -> Callable:
 
 
 def make_rollout(model, max_len: int, seq_per_img: int,
-                 temperature: float = 1.0, greedy_baseline: bool = True) -> Callable:
+                 temperature: float = 1.0, greedy_baseline: bool = True,
+                 decode_chunk: int = 0) -> Callable:
     """(params, feats, rng) -> (sampled (B*S, L), greedy (B, L)).
 
     One device program, ONE scan: the greedy baseline rows ride the same
@@ -97,6 +98,7 @@ def make_rollout(model, max_len: int, seq_per_img: int,
     per-step matmuls are too small to hide a second scan's sequential
     latency on TPU.  Pass ``greedy_baseline=False`` for pure-SCB runs to
     drop the baseline rows entirely (greedy output is then all-zeros).
+    ``decode_chunk`` > 0 = early-exit chunked rollout (ops.sampling).
     """
 
     def rollout(params, feats, rng):
@@ -105,11 +107,13 @@ def make_rollout(model, max_len: int, seq_per_img: int,
             sampled, _, greedy_toks = sample_with_baseline(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, temperature=temperature,
+                decode_chunk=decode_chunk,
             )
         else:
             sampled, _ = sample_captions(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+                decode_chunk=decode_chunk,
             )
             greedy_toks = jnp.zeros(
                 (feats[0].shape[0], max_len), dtype=jnp.int32
@@ -121,7 +125,8 @@ def make_rollout(model, max_len: int, seq_per_img: int,
 
 def make_rollout_fused(model, max_len: int, seq_per_img: int,
                        temperature: float = 1.0,
-                       greedy_baseline: bool = True) -> Callable:
+                       greedy_baseline: bool = True,
+                       decode_chunk: int = 0) -> Callable:
     """(params, feats, rng) -> (sampled (B*S, L), fetch).
 
     The overlapped CST pipeline's rollout: ``sampled`` stays on device for
@@ -139,12 +144,14 @@ def make_rollout_fused(model, max_len: int, seq_per_img: int,
             sampled, _, greedy = sample_with_baseline(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, temperature=temperature,
+                decode_chunk=decode_chunk,
             )
             fetch = jnp.concatenate([sampled, greedy], axis=0)
         else:
             sampled, _ = sample_captions(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+                decode_chunk=decode_chunk,
             )
             fetch = sampled
         return sampled, fetch
@@ -163,6 +170,7 @@ def make_fused_cst_step(
     scb_gt_baseline=None,      # (V,) f32 per-video baseline for scb-gt
     ref_chunk: int | None = None,
     guard: bool = False,
+    decode_chunk: int = 0,
 ) -> Callable:
     """(state, feats, video_ix, rng) -> (state, metrics): the ENTIRE CST
     iteration as ONE device program — rollout, on-device CIDEr-D rewards
@@ -178,6 +186,12 @@ def make_fused_cst_step(
     ``ref_chunk`` bounds the reward's transient HBM (see
     ops.jax_ciderd.auto_ref_chunk); scores agree to float32 ULP level
     either way (test-pinned).
+
+    ``decode_chunk`` > 0 runs the rollout with early-exit chunking
+    (ops.sampling) — bit-identical samples, fewer executed decode steps
+    once the whole batch has terminated; the executed count is reported
+    as ``metrics['rollout_steps']`` so the saving is visible per step in
+    metrics.jsonl and the bench.
     """
     from ..ops.jax_ciderd import ciderd_scores
 
@@ -190,14 +204,16 @@ def make_fused_cst_step(
     def step(state: TrainState, feats, video_ix, rng):
         variables = {"params": state.params}
         if baseline == "greedy":
-            sampled, _, greedy = sample_with_baseline(
+            sampled, _, greedy, rollout_steps = sample_with_baseline(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, temperature=temperature,
+                decode_chunk=decode_chunk, return_steps=True,
             )
         else:
-            sampled, _ = sample_captions(
+            sampled, _, rollout_steps = sample_captions(
                 model, variables, feats, rng, max_len,
                 seq_per_img=seq_per_img, greedy=False, temperature=temperature,
+                decode_chunk=decode_chunk, return_steps=True,
             )
             greedy = None
         sampled = jax.lax.stop_gradient(sampled)
@@ -235,6 +251,10 @@ def make_fused_cst_step(
             "reward": r_sample.mean(),
             "baseline": r_base.mean(),
             "advantage": advantage.mean(),
+            # decode steps the rollout actually executed (== max_len on
+            # the legacy path; a chunk multiple under --decode_chunk once
+            # the whole batch terminates early)
+            "rollout_steps": rollout_steps.astype(jnp.float32),
         })
         return new_state, metrics
 

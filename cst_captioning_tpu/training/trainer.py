@@ -67,6 +67,14 @@ from .steps import make_rl_grad_step, make_rollout_fused, make_xe_step
 log = logging.getLogger("cst_captioning_tpu.train")
 
 
+class NegativeAdvantageAbort(RuntimeError):
+    """Raised (opt-in: --abort_on_negative_advantage_window) when every
+    logged advantage in the detector's rolling window is negative — the
+    baseline dominates the samples, REINFORCE is only suppressing typical
+    sequences, and an unattended chain should stop instead of burning its
+    chip window on a collapsing stage.  train.py maps it to exit 4."""
+
+
 def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
     """CaptionModel from the opts namespace (reference --model_type etc.)."""
     import jax.numpy as jnp
@@ -377,12 +385,20 @@ class Trainer:
                     "verification — torn write; resuming from the last "
                     "verified step %d instead", latest, resume_step)
             self.state = self.ckpt.restore(self.state, step=resume_step)
-            log.info("resumed from step %d in %s", int(self.state.step),
+            log.info("resumed from step %d in %s", int(resume_step),
                      opt.checkpoint_path)
         elif self.ckpt.latest_step is not None:
             log.warning(
                 "every checkpoint in %s failed integrity verification; "
                 "starting this stage from scratch", opt.checkpoint_path)
+        # HOST-side step truth for the trainer's control plane (loop
+        # position, rollout key stream, summaries): same value as the
+        # device state.step on a healthy stack, but sourced from the
+        # checkpoint directory's host-verified step number instead of a
+        # device scalar fetch — the same no-device-scalar rule the
+        # rollback path follows (this session's native stack occasionally
+        # garbles scalar fetches; RESILIENCE.md caveat).
+        self._host_step = int(resume_step) if resume_step is not None else 0
         # Divergence-rollback target: a HOST-memory snapshot of the last
         # known-good state, refreshed at every checkpoint save (and here,
         # right after a resume — a fresh run deliberately has NO snapshot
@@ -413,6 +429,13 @@ class Trainer:
                 return _inner(state, [t[video_ix] for t in tables],
                               labels, weights, rng)
 
+        # Donation policy (ISSUE 3 tentpole): the state — params + optimizer
+        # moments, the largest live buffers — is donated into every update
+        # step (donate_argnums=(0,)), so XLA updates them in place instead
+        # of holding old+new copies across the step.  Batch args are NOT
+        # donated: these programs have no batch-shaped outputs to alias
+        # them onto, so XLA would skip the donation with a warning and
+        # keep the buffer anyway (pinned by tests/test_decode_fastpath).
         self.xe_step = data_parallel_jit(
             xe_raw, self.mesh, batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
@@ -550,15 +573,27 @@ class Trainer:
         if max(adv) < 0 and np.mean(adv) < -0.05:
             rew = np.mean([r for _, r, _ in hist])
             base = np.mean([b for _, _, b in hist])
-            log.warning(
+            msg = (
                 "advantage has been negative on every logged step so far "
                 "(mean %.3f; sampled reward %.3f vs baseline %.3f): the "
                 "baseline dominates the samples, so REINFORCE is only "
                 "suppressing typical sequences and the policy is likely "
                 "to degenerate.  Remedies: --rl_baseline scb-sample/"
                 "scb-gt (centred by construction), lower --temperature, "
-                "or a lower --learning_rate.", np.mean(adv), rew, base)
+                "or a lower --learning_rate." % (np.mean(adv), rew, base))
             self._adv_warned = True
+            # getattr chain, not self.opt: the detector is also driven as
+            # a bound-free method over a bare namespace in unit tests.
+            opt = getattr(self, "opt", None)
+            if opt is not None and getattr(
+                    opt, "abort_on_negative_advantage_window", 0):
+                # Opt-in hard stop for unattended chains: surface the
+                # collapsing stage now (exit 4 via train.py) rather than
+                # training a degenerating policy for the rest of the
+                # stage's epoch/chip budget.
+                self._telemetry.inc("negative_advantage_aborts")
+                raise NegativeAdvantageAbort(msg)
+            log.warning(msg)
 
     def _log_metrics(self, step: int, scope: str,
                      metrics: Dict[str, float]) -> None:
@@ -650,7 +685,8 @@ class Trainer:
         # redrawn after resume — under the restored params, which is the
         # correct on-policy behavior; checkpoints written by save_recovery
         # drain the pipeline first, so this only applies to hard crashes.)
-        self._rl_dispatch_step = int(self.state.step)
+        # Host-side step mirror, not a device scalar fetch (see _host_step).
+        self._rl_dispatch_step = self._host_step
         if getattr(opt, "device_rewards", 0):
             self._setup_fused_rl(refs)
             return
@@ -700,7 +736,8 @@ class Trainer:
         rollout_raw = make_rollout_fused(
             self.model, opt.max_length, opt.seq_per_img,
             temperature=opt.temperature,
-            greedy_baseline=opt.rl_baseline == "greedy")
+            greedy_baseline=opt.rl_baseline == "greedy",
+            decode_chunk=getattr(opt, "decode_chunk", 0))
         rl_raw = make_rl_grad_step(self.model, opt.seq_per_img,
                                    guard=self._guard is not None)
         if self._feat_tables is not None:
@@ -721,6 +758,10 @@ class Trainer:
             # keep the batch sharding; fetch leaves for the host either way.
             out_batch_tree=(True, True),
         )
+        # State donated (see xe_step donation-policy note); the rollout
+        # above donates nothing — its params input is the same live params
+        # the grad step still reads, and its feats stay in flight in the
+        # pipeline until the grad step consumes them.
         self.rl_step = data_parallel_jit(
             rl_raw, self.mesh, batch_argnums=(1, 2, 3), donate_argnums=(0,),
         )
@@ -820,6 +861,7 @@ class Trainer:
             baseline=opt.rl_baseline, temperature=opt.temperature,
             scb_gt_baseline=scb_gt, ref_chunk=ref_chunk,
             guard=self._guard is not None,
+            decode_chunk=getattr(opt, "decode_chunk", 0),
         )
         if self._feat_tables is not None:
             feat_tables = self._feat_tables
@@ -1044,6 +1086,7 @@ class Trainer:
             scorers=scorers,
             mesh=self.mesh,  # decode shards over data axis, no idle chips
             beat=self._watchdog.beat,  # long val decode is not a wedge
+            decode_chunk=getattr(self.opt, "decode_chunk", 0),
         )
         self._watchdog.beat()  # host-side scoring done too
         return scores
@@ -1060,7 +1103,9 @@ class Trainer:
             feat_dtype=self._feat_dtype(),
             telemetry=self._telemetry,
         ))
-        start_step = int(self.state.step)
+        # Host-side loop position, never a device scalar fetch (_host_step
+        # note in _init): identical to state.step on a healthy stack.
+        start_step = self._host_step
         total_steps = opt.max_epochs * bpe
         best = self.ckpt.infos.get("best_score")
         best = float("-inf") if best is None else float(best)
@@ -1080,7 +1125,7 @@ class Trainer:
             return {
                 "best_score": None if best == float("-inf") else best,
                 "best_step": self.ckpt.best_step,
-                "last_step": int(self.state.step),
+                "last_step": start_step,
                 "history": self.history,
             }
         self._log_t0 = time.time()
@@ -1213,7 +1258,8 @@ class Trainer:
                             and (step + 1) // bpe >= opt.min_epochs):
                         log.info("early stop: no %s improvement in %d epochs",
                                  opt.eval_metric, patience)
-                        break
+                        step += 1  # count the completed step (the loop's
+                        break      # own += 1 is skipped by the break)
                 else:
                     with self._telemetry.phase("ckpt"):
                         self.ckpt.save(step + 1, self.state)
@@ -1232,10 +1278,14 @@ class Trainer:
                     self._guard.total_skipped, self._guard.rollbacks)
         if profiling:  # run ended inside the trace window
             jax.profiler.stop_trace()
+        # The loop's own host counter is the step truth (== state.step on
+        # a healthy stack) — summaries must not depend on a device scalar
+        # fetch this environment can garble (RESILIENCE.md caveat).
+        self._host_step = step
         return {
             "best_score": None if best == float("-inf") else best,
             "best_step": self.ckpt.best_step,
-            "last_step": int(self.state.step),
+            "last_step": step,
             "history": self.history,
         }
 

@@ -151,12 +151,22 @@ def enable_compile_cache(cache_dir: str) -> bool:
     if not cache_dir:
         return False
     try:
-        path = os.path.expanduser(cache_dir)
+        # A parent harness (the test suite, CI) that exports
+        # JAX_COMPILATION_CACHE_DIR / JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS
+        # owns the cache policy for every child it spawns; the CLI default
+        # must not clobber it — otherwise spawned train.py/eval.py children
+        # repopulate the operator's cache dir and recompile every
+        # sub-second program the parent's lower threshold would have cached.
+        path = os.path.expanduser(
+            os.environ.get("JAX_COMPILATION_CACHE_DIR") or cache_dir)
+        min_secs = float(os.environ.get(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", 1.0))
         os.makedirs(path, exist_ok=True)
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
         return True
     except Exception as e:  # pragma: no cover - env-specific failures
         import logging

@@ -111,6 +111,7 @@ def main(argv=None) -> int:
             beam_size=opt.beam_size, length_norm=opt.length_norm,
             mesh=mesh,
             beat=watchdog.beat,
+            decode_chunk=getattr(opt, "decode_chunk", 0),
         )
     log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
     if opt.result_file:

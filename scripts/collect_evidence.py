@@ -77,14 +77,32 @@ def main() -> int:
         take(f"{stage}_beam5.json")
 
     # Regenerate the report against the live out_dir so report + copies
-    # agree, then keep both renderings.
+    # agree, then keep both renderings.  A wedged/killed chain_report must
+    # degrade to "bundle without report" — the MANIFEST below still gets
+    # written (with its nonzero report_rc recording the failure), because
+    # a timed-out report leaving a provenance-less bundle would be worse
+    # than a report-less one (round-5 advisor).
     report_json = os.path.join(dst, "report.json")
-    with open(os.path.join(dst, "report.md"), "w") as f:
-        rc = subprocess.run(
-            [sys.executable, "scripts/chain_report.py", "--out_dir", src,
-             "--json", report_json],
-            cwd=REPO, stdout=f, stderr=subprocess.STDOUT, timeout=300,
-        ).returncode
+    try:
+        with open(os.path.join(dst, "report.md"), "w") as f:
+            rc = subprocess.run(
+                [sys.executable, "scripts/chain_report.py", "--out_dir", src,
+                 "--json", report_json],
+                cwd=REPO, stdout=f, stderr=subprocess.STDOUT, timeout=300,
+            ).returncode
+    except (subprocess.TimeoutExpired, OSError) as e:
+        rc = 124 if isinstance(e, subprocess.TimeoutExpired) else 1
+        print(f"chain_report failed ({e}); writing MANIFEST with "
+              f"report_rc={rc}", file=sys.stderr)
+        # A timeout can leave a half-written report.md (the file was
+        # opened before the child wedged) and chain_report may have
+        # part-written its --json; a truncated artifact in the bundle is
+        # worse than none, so drop both rather than list them below.
+        for r in ("report.md", "report.json"):
+            try:
+                os.remove(os.path.join(dst, r))
+            except OSError:
+                pass
     # The manifest lists what EXISTS, not what was attempted: a failed
     # chain_report must not leave the bundle claiming a report it lacks.
     copied += [r for r in ("report.md", "report.json")

@@ -375,11 +375,14 @@ def main() -> int:
                         "(round-4 midscale probe stopped XE at 16/100 "
                         "epochs, well short of convergence)")
     p.add_argument("--min_epochs", type=int, default=30,
-                   help="floor under early stopping for XE/WXE: at small "
-                        "steps-per-epoch scales val CIDEr ties at ~0 for "
-                        "many early epochs and patience would fire before "
-                        "learning starts (observed live at 64 videos / "
-                        "batch 16: stopped at epoch 18 with CIDEr 0.02)")
+                   help="floor under early stopping for the COLD-START XE "
+                        "stage only (WXE warm-starts from a converged XE "
+                        "and keeps normal early stopping — see xe_floor in "
+                        "main): at small steps-per-epoch scales val CIDEr "
+                        "ties at ~0 for many early epochs and patience "
+                        "would fire before learning starts (observed live "
+                        "at 64 videos / batch 16: stopped at epoch 18 with "
+                        "CIDEr 0.02)")
     p.add_argument("--lr_decay_every", type=int, default=25,
                    help="staircase decay period in epochs for XE/WXE "
                         "(the 640-video synthetic has ~1/10 the steps of "

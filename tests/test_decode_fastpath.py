@@ -1,0 +1,339 @@
+"""Rollout fast path: chunked early-exit decode + buffer donation.
+
+Pins the two contracts the fast path ships on (ISSUE 3):
+
+1. ``decode_chunk > 0`` is BIT-IDENTICAL to the legacy full-length scan
+   for the multinomial sampler, the mixed sampled+greedy rollout, greedy
+   decode, and beam search — including a chunk that does not divide
+   max_len (the overrun chunk), a batch whose rows all finish early
+   (fewer executed steps), and a batch that never finishes (full length,
+   same outputs).
+2. Buffer donation on the 8-device CPU mesh: the donated STATE (params +
+   optimizer moments, the largest live buffers) is consumed in place —
+   the old state is deleted, reusing it raises, and the updated state
+   threads through further steps; ``donate_batch=True`` aliases batch
+   args into batch-shaped outputs where they exist and is provably
+   skipped (buffer survives) where they don't — which is why the shipped
+   train steps donate only the state; and the rollout->pipeline->
+   grad-step ownership keeps in-flight feats alive until their grad step
+   consumed them.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.beam import beam_search, beam_search_tokens
+from cst_captioning_tpu.ops.sampling import (
+    sample_captions,
+    sample_tokens,
+    sample_with_baseline,
+)
+from cst_captioning_tpu.parallel.dp import data_parallel_jit
+from cst_captioning_tpu.parallel.mesh import batch_sharding, make_mesh
+from cst_captioning_tpu.training.pipeline import RewardPipeline
+from cst_captioning_tpu.training.state import create_train_state, make_optimizer
+from cst_captioning_tpu.training.steps import (
+    make_rl_grad_step,
+    make_rollout_fused,
+    make_xe_step,
+)
+
+VOCAB = 12
+B = 3
+T = 5
+D = 7
+MAX_LEN = 6
+
+
+def make_model(decoder_type="lstm"):
+    model = CaptionModel(
+        vocab_size=VOCAB, embed_size=16, hidden_size=16, attn_size=16,
+        use_attention=True, dropout_rate=0.0,
+        decoder_type=decoder_type, num_heads=2, num_tx_layers=1,
+        tx_max_len=MAX_LEN,
+    )
+    feats = [jnp.asarray(np.random.default_rng(0).normal(size=(B, T, D)),
+                         jnp.float32)]
+    labels = jnp.zeros((B, MAX_LEN), dtype=jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), feats, labels)
+    return model, variables, feats
+
+
+def assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- bit-exactness vs the legacy scan -------------------------------------
+
+# 2 divides MAX_LEN=6; 4 exercises the overrun chunk (padded length 8).
+CHUNKS = (2, 4)
+
+
+# lstm gets both chunk shapes; the transformer carry (token buffer +
+# position counter) is pinned once on the harder overrun chunk — each
+# combination is a fresh scan compile, and suite wall-time is budgeted.
+@pytest.mark.parametrize("decoder_type,chunk",
+                         [("lstm", 2), ("lstm", 4), ("transformer", 4)])
+def test_chunked_sampler_bit_exact(decoder_type, chunk):
+    model, variables, feats = make_model(decoder_type)
+    legacy = sample_captions(model, variables, feats, jax.random.PRNGKey(1),
+                             MAX_LEN, seq_per_img=2)
+    chunked = sample_captions(model, variables, feats, jax.random.PRNGKey(1),
+                              MAX_LEN, seq_per_img=2, decode_chunk=chunk)
+    assert_trees_equal(legacy, chunked)
+
+
+@pytest.mark.parametrize("chunk", (4,))  # overrun chunk; exact-division
+def test_chunked_rollout_with_baseline_bit_exact(chunk):
+    """The trainer's actual rollout program: multinomial rows + greedy
+    baseline rows in one scan, per-row greedy flag.  (Exact-division
+    chunks are covered by the sampler/beam/fused-step tests — each case
+    is a fresh compile and suite wall-time is budgeted.)"""
+    model, variables, feats = make_model()
+    legacy = sample_with_baseline(model, variables, feats,
+                                  jax.random.PRNGKey(2), MAX_LEN, 2)
+    chunked = sample_with_baseline(model, variables, feats,
+                                   jax.random.PRNGKey(2), MAX_LEN, 2,
+                                   decode_chunk=chunk)
+    assert_trees_equal(legacy, chunked)
+
+
+@pytest.mark.parametrize("chunk", CHUNKS)
+def test_chunked_beam_bit_exact(chunk):
+    model, variables, feats = make_model()
+    legacy = beam_search(model, variables, feats, beam_size=3,
+                         max_len=MAX_LEN, length_norm=0.7)
+    chunked = beam_search(model, variables, feats, beam_size=3,
+                          max_len=MAX_LEN, length_norm=0.7,
+                          decode_chunk=chunk)
+    assert_trees_equal(legacy, chunked)
+
+
+# -- early exit / never-finish on a controlled step -----------------------
+
+
+class TableStep:
+    """Deterministic decode 'model': logits from a fixed (L, V, V) table
+    indexed by (step, prev token); carry counts steps.  EOS behavior is
+    controlled by the table's column 0."""
+
+    def __init__(self, vocab, table_len, eos_logit, seed=0):
+        rng = np.random.default_rng(seed)
+        tab = rng.normal(size=(table_len, vocab, vocab)).astype(np.float32)
+        tab[:, :, 0] = eos_logit
+        self.table = jnp.asarray(tab)
+
+    def __call__(self, carry, token):
+        return carry + 1, self.table[carry][token]
+
+
+def test_sampler_early_exit_executes_fewer_steps():
+    """All rows greedy-terminate at step 1 -> one chunk executes, outputs
+    (incl. logprobs) still bit-equal to the 12-step legacy scan."""
+    step = TableStep(5, 12, eos_logit=50.0)
+    legacy = sample_tokens(step, jnp.zeros((), jnp.int32), 4, 12,
+                           jax.random.PRNGKey(0), greedy=True,
+                           return_steps=True)
+    chunked = sample_tokens(step, jnp.zeros((), jnp.int32), 4, 12,
+                            jax.random.PRNGKey(0), greedy=True,
+                            decode_chunk=4, return_steps=True)
+    assert_trees_equal(legacy[:2], chunked[:2])
+    assert int(legacy[2]) == 12
+    assert int(chunked[2]) == 4          # one chunk, not max_len
+
+
+def test_sampler_never_finishes_runs_full_length():
+    """EOS impossible -> every chunk runs; executed == max_len even with
+    an overrun chunk (5 does not divide 12), outputs bit-equal."""
+    step = TableStep(5, 15, eos_logit=-1e9, seed=1)
+    legacy = sample_tokens(step, jnp.zeros((), jnp.int32), 4, 12,
+                           jax.random.PRNGKey(3), return_steps=True)
+    chunked = sample_tokens(step, jnp.zeros((), jnp.int32), 4, 12,
+                            jax.random.PRNGKey(3), decode_chunk=5,
+                            return_steps=True)
+    assert_trees_equal(legacy[:2], chunked[:2])
+    assert int(legacy[2]) == 12
+    assert int(chunked[2]) == 12
+    # nothing terminated: every row is full-length non-zero tokens
+    assert (np.asarray(chunked[0]) != 0).all()
+
+
+def test_beam_early_exit_and_never_finish():
+    eos = TableStep(5, 15, eos_logit=50.0)
+    legacy = beam_search_tokens(eos, jnp.zeros((), jnp.int32), batch=2,
+                                beam_size=3, max_len=12, return_steps=True)
+    chunked = beam_search_tokens(eos, jnp.zeros((), jnp.int32), batch=2,
+                                 beam_size=3, max_len=12, decode_chunk=4,
+                                 return_steps=True)
+    assert_trees_equal(legacy[:3], chunked[:3])
+    assert int(chunked[3]) == 4 and int(legacy[3]) == 12
+
+    never = TableStep(5, 15, eos_logit=-1e9, seed=2)
+    legacy = beam_search_tokens(never, jnp.zeros((), jnp.int32), batch=2,
+                                beam_size=3, max_len=12, return_steps=True)
+    chunked = beam_search_tokens(never, jnp.zeros((), jnp.int32), batch=2,
+                                 beam_size=3, max_len=12, decode_chunk=5,
+                                 return_steps=True)
+    assert_trees_equal(legacy[:3], chunked[:3])
+    assert int(chunked[3]) == 12
+
+
+# -- fused CST step: chunked == legacy end to end -------------------------
+
+
+def test_fused_cst_step_chunked_matches_legacy():
+    from cst_captioning_tpu.training.device_rewards import build_device_tables
+    from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+    words = ["a", "man", "is", "cooking", "dog", "runs", "the", "park"]
+    w2i = {w: i + 1 for i, w in enumerate(words)}
+    rng = np.random.default_rng(4)
+    refs = {f"v{v}": [" ".join(rng.choice(words, 5)) for _ in range(3)]
+            for v in range(4)}
+    model = CaptionModel(vocab_size=len(words) + 1, embed_size=16,
+                         hidden_size=16, attn_size=16, dropout_rate=0.0)
+    tx, _ = make_optimizer(learning_rate=1e-2, grad_clip=5.0)
+    state = create_train_state(model, jax.random.PRNGKey(0), [(3, 8)],
+                               8, 2, tx, batch_size=4)
+    feats = [jax.random.normal(jax.random.PRNGKey(1), (4, 3, 8))]
+    corpus, tables, video_row = build_device_tables(refs, w2i)
+    vix = np.asarray([video_row[v] for v in refs], np.int32)
+    key = jax.random.PRNGKey(9)
+
+    legacy = jax.jit(make_fused_cst_step(model, 8, 2, corpus, tables))
+    chunked = jax.jit(make_fused_cst_step(model, 8, 2, corpus, tables,
+                                          decode_chunk=3))
+    s_legacy, m_legacy = legacy(state, feats, vix, key)
+    s_chunked, m_chunked = chunked(state, feats, vix, key)
+    assert_trees_equal(s_legacy.params, s_chunked.params)
+    np.testing.assert_array_equal(np.asarray(m_legacy["loss"]),
+                                  np.asarray(m_chunked["loss"]))
+    assert float(m_legacy["rollout_steps"]) == 8.0
+    assert 0 < float(m_chunked["rollout_steps"]) <= 8.0
+
+
+# -- buffer donation under the 8-device mesh ------------------------------
+
+
+def _xe_setup(mesh):
+    model = CaptionModel(vocab_size=VOCAB, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    tx, _ = make_optimizer(learning_rate=1e-2)
+    state = create_train_state(model, jax.random.PRNGKey(0), [(T, D)],
+                               MAX_LEN, 1, tx, batch_size=8)
+    sh = batch_sharding(mesh)
+    feats = [jax.device_put(
+        np.random.default_rng(0).normal(size=(8, T, D)).astype(np.float32),
+        sh)]
+    labels = jax.device_put(
+        np.random.default_rng(1).integers(0, VOCAB, (8, MAX_LEN))
+        .astype(np.int32), sh)
+    weights = jax.device_put(np.ones((8,), np.float32), sh)
+    return model, state, feats, labels, weights
+
+
+def test_state_donation_consumes_old_state_on_mesh():
+    """The big donation: the state (params + optimizer moments) aliases
+    into the updated state.  Old state deleted, reuse raises, update
+    threads through — and the numbers match an undonated reference."""
+    mesh = make_mesh(jax.devices()[:8])
+    model, state, feats, labels, weights = _xe_setup(mesh)
+    raw = make_xe_step(model, 1)
+    rng = jax.random.PRNGKey(0)
+
+    plain = data_parallel_jit(raw, mesh, batch_argnums=(1, 2, 3),
+                              donate_argnums=())
+    ref_state, m_ref = plain(state, feats, labels, weights, rng)
+    assert not jax.tree_util.tree_leaves(state.params)[0].is_deleted()
+
+    donating = data_parallel_jit(raw, mesh, batch_argnums=(1, 2, 3),
+                                 donate_argnums=(0,))
+    new_state, m = donating(state, feats, labels, weights, rng)
+    np.testing.assert_array_equal(np.asarray(m["loss"]),
+                                  np.asarray(m_ref["loss"]))
+    # donated and undonated programs compile to different XLA buffer
+    # assignments, so tight-allclose (not bitwise) is the right contract
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(new_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # the donated state was consumed in place
+    assert all(l.is_deleted()
+               for l in jax.tree_util.tree_leaves(state.params))
+    with pytest.raises(RuntimeError):
+        np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+    # batch args were NOT donated (no batch-shaped output to alias onto;
+    # the trainer deliberately leaves donate_batch off — see dp.py)
+    assert not labels.is_deleted()
+    # the updated state keeps training
+    _, m2 = donating(new_state, feats, labels, weights, rng)
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_donate_batch_aliases_only_matching_outputs():
+    """donate_batch contract: a batch arg aliases into a batch-shaped
+    output of the same shape/dtype (buffer consumed); one without a
+    matching output survives — donation can never invalidate a buffer a
+    program could not reuse."""
+    mesh = make_mesh(jax.devices()[:8])
+    sh = batch_sharding(mesh)
+
+    def transform(_state, tokens, scale):
+        return (tokens * 2).astype(tokens.dtype), scale.sum()
+
+    fn = data_parallel_jit(transform, mesh, batch_argnums=(1, 2),
+                           donate_argnums=(), donate_batch=True,
+                           out_batch_tree=(True, False))
+    tokens = jax.device_put(np.arange(48, dtype=np.int32).reshape(8, 6), sh)
+    scale = jax.device_put(np.ones((8,), np.float32), sh)
+    out, s = fn(jnp.zeros(()), tokens, scale)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(48).reshape(8, 6) * 2)
+    assert tokens.is_deleted()       # aliased into `out`
+    assert not scale.is_deleted()    # only output is replicated: skipped
+
+
+def test_rl_pipeline_keeps_inflight_feats_alive():
+    """Host-path ownership at depth 2 on the mesh: the rollout donates
+    nothing, so feats stay readable while their grad step is still
+    pending; every step completes exactly once through the real
+    RewardPipeline with the donated-state grad step."""
+    mesh = make_mesh(jax.devices()[:8])
+    model, state, *_ = _xe_setup(mesh)
+    rollout = data_parallel_jit(
+        make_rollout_fused(model, MAX_LEN, 1, decode_chunk=2),
+        mesh, batch_argnums=(1,), donate_argnums=(),
+        out_batch_tree=(True, True))
+    rl_step = data_parallel_jit(
+        make_rl_grad_step(model, 1), mesh, batch_argnums=(1, 2, 3),
+        donate_argnums=(0,))
+    sh = batch_sharding(mesh)
+    rng = np.random.default_rng(7)
+
+    def fresh_feats():
+        return [jax.device_put(
+            rng.normal(size=(8, T, D)).astype(np.float32), sh)]
+
+    pipe = RewardPipeline(
+        rollout, rl_step,
+        lambda ctx, s, g: (np.ones(s.shape[0], np.float32), {}), depth=2)
+    batches = [fresh_feats() for _ in range(4)]
+    done = 0
+    for i, feats in enumerate(batches):
+        state, completed = pipe.push(state, feats, jax.random.PRNGKey(i),
+                                     jax.random.PRNGKey(100 + i), i)
+        done += len(completed)
+        # in-flight feats must remain readable until their grad step runs
+        for pending in pipe._pending:
+            assert not pending[2][0].is_deleted()
+            np.asarray(pending[2][0])
+    state, completed = pipe.drain(state)
+    done += len(completed)
+    assert done == 4
+    assert len(pipe) == 0

@@ -262,3 +262,37 @@ def test_event_log_edge_cases(tmp_path):
     status = chain_report.chain_status(events, now=events[-1]["ts"] + 10.0)
     assert status["state"] == "running" and status["stage"] == "xe"
     assert status["last_event_age_s"] == pytest.approx(10.0, abs=1.0)
+
+
+def test_collect_evidence_survives_report_timeout(tmp_path, monkeypatch):
+    """A wedged/killed chain_report must not leave a provenance-less
+    bundle: collect_evidence still writes MANIFEST.json, recording the
+    failure as a nonzero report_rc (round-5 advisor)."""
+    src = tmp_path / "run"
+    src.mkdir()
+    (src / "chain_events.jsonl").write_text(
+        json.dumps({"event": "chain_start", "argv": ["--num_videos", "6"]})
+        + "\n")
+    dest = tmp_path / "artifacts"
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import collect_evidence
+    finally:
+        sys.path.pop(0)
+
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="chain_report", timeout=300)
+
+    monkeypatch.setattr(collect_evidence.subprocess, "run", boom)
+    monkeypatch.setattr(sys, "argv", [
+        "collect_evidence.py", "--out_dir", str(src), "--name", "probe",
+        "--dest", str(dest)])
+    assert collect_evidence.main() == 0
+
+    with open(dest / "probe" / "MANIFEST.json") as f:
+        manifest = json.load(f)
+    assert manifest["report_rc"] == 124
+    assert "chain_events.jsonl" in manifest["files"]
+    assert "report.json" not in manifest["files"]
+    assert "scale_chain.py" in manifest["regen_command"]

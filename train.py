@@ -19,7 +19,7 @@ import sys
 
 from cst_captioning_tpu.opts import parse_opts
 from cst_captioning_tpu.parallel.dp import distributed_init
-from cst_captioning_tpu.training.trainer import Trainer
+from cst_captioning_tpu.training.trainer import NegativeAdvantageAbort, Trainer
 from cst_captioning_tpu.utils.platform import (configure_cli_logging,
                                                enable_compile_cache)
 from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
@@ -43,6 +43,13 @@ def main(argv=None) -> int:
     trainer = Trainer(opt)
     try:
         result = trainer.train()
+    except NegativeAdvantageAbort as e:
+        # Opt-in hard stop (--abort_on_negative_advantage_window): a
+        # distinct exit code so an unattended chain can tell "stage
+        # collapsing, reconfigure" (4) apart from crash (1) / wedge (124).
+        print(json.dumps({"aborted": "negative_advantage_window",
+                          "detail": str(e)}))
+        return 4
     finally:
         trainer.close()
     summary = {
