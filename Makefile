@@ -40,13 +40,17 @@ test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
 
 # Chaos drills (RESILIENCE.md): drive the real trainer through injected
-# faults — torn checkpoints, NaN gradients, loader errors, wedges — and
-# assert end-to-end recovery.  Includes the `slow` subprocess drills that
-# the default `pytest -m 'not slow'` (tier-1) skips; the fast subset of
-# tests/test_resilience.py rides in tier-1 automatically.
+# faults — torn checkpoints, NaN gradients, loader errors, wedges, and
+# PREEMPTION (a real SIGTERM via `preempt@step=N`: boundary save ->
+# taxonomy exit 75 -> restart -> bit-exact resume) — and assert
+# end-to-end recovery.  Includes the `slow` subprocess drills that the
+# default `pytest -m 'not slow'` (tier-1) skips; the fast subsets of
+# tests/test_resilience.py and tests/test_preemption.py (signal-flag,
+# exit-code taxonomy, harness classification units) ride in tier-1
+# automatically.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
-	  tests/test_watchdog.py -q
+	  tests/test_preemption.py tests/test_watchdog.py -q
 
 # -- three-stage recipe (XE -> WXE -> CST) --------------------------------
 

@@ -125,11 +125,11 @@ class CaptionLoader:
 
     # -- batch assembly ----------------------------------------------------
 
-    def _pick_captions(self, video_ix: int) -> Tuple[np.ndarray, np.ndarray]:
-        """-> ((seq_per_img, L) caption rows, their indices within the video's
-        caption list); samples with replacement if the video has fewer."""
-        caps = self.ds.captions_for(video_ix)
-        n = caps.shape[0]
+    def _select_caption_rows(self, video_ix: int, n: int) -> np.ndarray:
+        """The ONE place caption-row selection consumes RNG draws: used by
+        ``next_batch`` (via ``_pick_captions``) and replayed draw-for-draw
+        by ``skip_batches`` so a fast-forwarded stream stays bit-identical
+        to one that actually served the skipped batches."""
         if n == 0:
             raise ValueError(
                 f"video {self.ds.video_ids[video_ix]!r} has no captions"
@@ -139,8 +139,35 @@ class CaptionLoader:
                 else np.arange(self.seq_per_img)
         else:
             sel = self._rng.choice(n, self.seq_per_img, replace=True)
-        sel = np.sort(sel)
+        return np.sort(sel)
+
+    def _pick_captions(self, video_ix: int) -> Tuple[np.ndarray, np.ndarray]:
+        """-> ((seq_per_img, L) caption rows, their indices within the video's
+        caption list); samples with replacement if the video has fewer."""
+        caps = self.ds.captions_for(video_ix)
+        sel = self._select_caption_rows(video_ix, caps.shape[0])
         return caps[sel], sel
+
+    def skip_batches(self, n: int) -> None:
+        """Fast-forward the stream by ``n`` batches WITHOUT assembling them:
+        replays exactly the RNG draws ``next_batch`` would have made (epoch
+        shuffles + per-video caption selections) at index-bookkeeping cost
+        — no h5 feature/label reads.
+
+        This is the data half of deterministic resume: a run restored at
+        step N calls ``skip_batches(N)`` so it consumes the SAME batch
+        sequence from step N onward that an uninterrupted run of the same
+        seed would have — without it, a resumed run replays the stream
+        from batch 0 and its post-resume params diverge from the
+        uninterrupted twin's."""
+        if n <= 0:
+            return
+        log.info("fast-forwarding the batch stream by %d batch(es) "
+                 "(deterministic resume alignment)", n)
+        for _ in range(int(n)):
+            for v in self._next_indices(self.batch_size):
+                self._select_caption_rows(int(v), self.ds.num_captions(int(v)))
+            self._batches_served += 1
 
     def next_batch(self) -> Batch:
         if (self._faults is not None

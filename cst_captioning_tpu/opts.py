@@ -14,6 +14,7 @@ checkpoint, not the CLI (SURVEY.md §5 config system).
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional, Sequence
 
 
@@ -269,6 +270,14 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--save_every_steps", type=int, default=0,
                    help="extra checkpoint every N steps for failure "
                         "recovery (0 = epoch boundaries only)")
+    g.add_argument("--save_interval_secs", type=float, default=0.0,
+                   help="wall-clock twin of --save_every_steps: force a "
+                        "recovery checkpoint when this many seconds have "
+                        "passed since the last save of any kind, so long "
+                        "CST stages bound preemption/crash loss by TIME "
+                        "even when step rate drifts.  Checked at step "
+                        "boundaries (real cadence = max(interval, one "
+                        "step)); 0 disables")
     g.add_argument("--wedge_timeout", type=float, default=0.0,
                    help="seconds without training-loop progress before the "
                         "process exits with status 124 for checkpointed "
@@ -313,6 +322,21 @@ def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
                         "and disables the guard")
 
 
+def _validated_fault_plan(text: str) -> str:
+    """argparse type for ``--fault_plan``: grammar errors become a
+    single-line usage error naming the bad token and the expected grammar
+    (argparse prints it and exits 2) instead of a Trainer-startup
+    traceback.  The validated TEXT is returned — the trainer re-parses it
+    into its own consumable plan instance."""
+    from .resilience.faults import FaultPlan
+
+    try:
+        FaultPlan.parse(text)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from None
+    return text
+
+
 def _add_resilience_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("resilience")
     g.add_argument("--divergence_guard", type=int, default=1,
@@ -345,13 +369,21 @@ def _add_resilience_args(p: argparse.ArgumentParser) -> None:
                         "abort message: scb-sample baseline, lower "
                         "temperature/lr).  0 (default) = warn once and "
                         "continue")
-    g.add_argument("--fault_plan", default=None,
+    # The env-var fallback is resolved HERE, as the argparse default, so a
+    # malformed CST_FAULT_PLAN gets the same one-line usage error as a
+    # malformed --fault_plan (argparse runs `type` on string defaults)
+    # instead of a Trainer-startup traceback.
+    g.add_argument("--fault_plan",
+                   default=os.environ.get("CST_FAULT_PLAN") or None,
+                   type=_validated_fault_plan,
                    help="CHAOS TESTING ONLY: comma-separated deterministic "
                         "fault specs injected into this run, e.g. "
                         "'ckpt_torn@step=40,nan_grad@step=55,"
-                        "loader_err@batch=12,wedge@step=70' (kind@step=N, "
-                        "kind@batch=N, or kind@step=N*K for K consecutive "
-                        "firings; grammar + taxonomy in RESILIENCE.md).  "
+                        "loader_err@batch=12,wedge@step=70,preempt@step=80' "
+                        "(kind@step=N, kind@batch=N, or kind@step=N*K for "
+                        "K consecutive firings; grammar + taxonomy in "
+                        "RESILIENCE.md).  Malformed specs are rejected "
+                        "here, at parse time, with a one-line usage error. "
                         "Falls back to the CST_FAULT_PLAN env var; unset = "
                         "every hook disarmed at zero cost")
 

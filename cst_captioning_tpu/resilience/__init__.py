@@ -13,9 +13,21 @@ in RESILIENCE.md):
   when to roll back to the last known-good checkpoint;
 - :mod:`integrity` — per-step checkpoint manifests (content checksums
   written after the orbax commit), verify-on-restore, and the newest-
-  verified-step walk-back that keeps auto-resume off torn checkpoints.
+  verified-step walk-back that keeps auto-resume off torn checkpoints;
+- :mod:`preemption` — SIGTERM/SIGINT -> checkpoint-requested flag; the
+  trainer honors it at the next step boundary with a verified save and a
+  dedicated resumable exit code;
+- :mod:`exitcodes` — the exit-code taxonomy (ok/resumable/wedge/fatal)
+  shared by the CLIs and the stage harness.
 """
 
+from .exitcodes import (
+    EXIT_ADVANTAGE_ABORT,
+    EXIT_PREEMPTED,
+    EXIT_WEDGE,
+    classify,
+    describe,
+)
 from .faults import FaultPlan, FaultSpec, InjectedFault
 from .guard import DivergenceGuard, DivergenceUnrecoverable
 from .integrity import (
@@ -24,8 +36,14 @@ from .integrity import (
     verify_step_dir,
     write_manifest,
 )
+from .preemption import PreemptedExit, PreemptionHandler
 
 __all__ = [
+    "EXIT_ADVANTAGE_ABORT",
+    "EXIT_PREEMPTED",
+    "EXIT_WEDGE",
+    "classify",
+    "describe",
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
@@ -35,4 +53,6 @@ __all__ = [
     "manifest_path",
     "verify_step_dir",
     "write_manifest",
+    "PreemptedExit",
+    "PreemptionHandler",
 ]

@@ -32,6 +32,10 @@ kind            keys on  effect at the injection site
                          read (the prefetch retry path must absorb it)
 ``wedge``       step     block the train loop forever (the watchdog must
                          turn this into a fast exit 124)
+``preempt``     step     deliver a REAL ``SIGTERM`` to the running process
+                         when step N is dispatched (the preemption layer
+                         must checkpoint at the next step boundary and exit
+                         with the resumable taxonomy code)
 ==============  =======  ====================================================
 
 Firing is deterministic and single-shot per (kind, index): a plan replayed
@@ -61,6 +65,7 @@ KINDS: Dict[str, str] = {
     "nan_grad": "step",
     "loader_err": "batch",
     "wedge": "step",
+    "preempt": "step",
 }
 
 _SPEC_RE = re.compile(

@@ -75,31 +75,27 @@ def write_manifest(step_dir: str) -> Dict[str, Dict]:
     with open(marker, "w") as f:
         f.flush()
         os.fsync(f.fileno())
-    tmp = manifest_path(step_dir) + ".tmp"
     try:
         files = {}
         for rel, path in _iter_payload_files(step_dir):
             files[rel] = {"bytes": os.path.getsize(path),
                           "sha256": _sha256(path)}
         manifest = {"version": 1, "files": files}
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, indent=1, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
+        atomic_json_write(manifest_path(step_dir), manifest,
+                          indent=1, sort_keys=True)
     except BaseException:
         # A CLEAN failure (caught and handled by the caller) must remove
-        # the marker as well as the tmp file: the checkpoint itself is
-        # whole, and marker-without-manifest would otherwise read as
-        # "torn" and get a perfectly good step quarantined on the next
-        # start.  Only a hard crash mid-hash — where no cleanup can run —
-        # leaves the marker, which is exactly the case it exists for.
-        for leftover in (tmp, marker):
-            try:
-                os.unlink(leftover)
-            except OSError:
-                pass
+        # the marker too (atomic_json_write already cleaned its tmp file):
+        # the checkpoint itself is whole, and marker-without-manifest
+        # would otherwise read as "torn" and get a perfectly good step
+        # quarantined on the next start.  Only a hard crash mid-hash —
+        # where no cleanup can run — leaves the marker, which is exactly
+        # the case it exists for.
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
         raise
-    os.replace(tmp, manifest_path(step_dir))
     try:
         os.unlink(marker)
     except OSError:
@@ -146,6 +142,35 @@ def verify_step_dir(step_dir: str, level: str = "full") -> Tuple[str, str]:
         log.debug("step %s has %d file(s) outside its manifest: %s",
                   step_dir, len(extra), sorted(extra)[:3])
     return "verified", f"{len(files)} file(s) match"
+
+
+def atomic_json_write(path: str, doc, **dump_kwargs) -> None:
+    """The repo's one durable-JSON discipline: write to ``path + ".tmp"``,
+    fsync the data, atomically rename over ``path``, then fsync the
+    directory so a crash can't lose the rename either.  A reader therefore
+    sees the old complete document or the new complete document, never a
+    torn one — the contract infos.json, telemetry.json, heartbeat.json,
+    and the step manifests all rely on.  ``dump_kwargs`` pass through to
+    :func:`json.dump`.  On failure the tmp file is removed and the
+    published document is untouched."""
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, **dump_kwargs)
+            # fsync before rename: a host crash can journal the rename
+            # without the data, leaving an EMPTY file — worse than the
+            # stale one the rename replaced.
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
 
 
 def fsync_dir(path: str) -> None:

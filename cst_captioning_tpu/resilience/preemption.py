@@ -1,0 +1,139 @@
+"""Signal-driven checkpoint-and-exit: preemption as a first-class fault.
+
+A scheduler preemption, spot reclaim, or operator ``kill`` delivers
+SIGTERM mid-step; without a handler the process dies wherever it stands,
+losing up to a full save interval of work and surfacing to the stage
+harness as an unclassifiable 143.  The preemption layer turns that into
+"resumed with at most one step of lost work":
+
+- :class:`PreemptionHandler` (installed by ``train.py`` before the slow
+  Trainer init) catches SIGTERM/SIGINT and only sets a flag — the handler
+  body must stay async-signal-safe-ish: no locks (a signal interrupting
+  the main thread inside the metrics registry's lock would deadlock on
+  ``inc``), no logging (same story for the logging module lock), no
+  allocation-heavy work;
+- the trainer loop checks the flag at every step boundary, forces a
+  verified checkpoint save through the normal manifest/integrity path,
+  stamps the preemption counters into telemetry, and raises
+  :class:`PreemptedExit`;
+- ``train.py`` maps :class:`PreemptedExit` to
+  :data:`~.exitcodes.EXIT_PREEMPTED` (75, ``EX_TEMPFAIL``), which
+  ``scripts/scale_chain.py`` classifies as "checkpoint advanced, restart
+  immediately" rather than burning a no-progress attempt.
+
+SIGINT keeps its interactive contract: the FIRST Ctrl-C requests the same
+graceful checkpoint-and-exit, and the handler then restores the previous
+SIGINT disposition so a second Ctrl-C is a hard ``KeyboardInterrupt`` for
+an operator who really means stop-now.  Repeated SIGTERMs are absorbed
+(counted) — a scheduler re-sending TERM during the grace window must not
+kill the save it is waiting for; the hard stop is its SIGKILL.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+
+class PreemptedExit(RuntimeError):
+    """Raised by the trainer at the step boundary that honors a preemption
+    signal; ``train.py`` maps it to ``exitcodes.EXIT_PREEMPTED``."""
+
+    def __init__(self, step: int, signal_name: str, saved: bool):
+        super().__init__(
+            f"preempted by {signal_name} at step {step} "
+            f"({'checkpoint saved' if saved else 'checkpoint already current'})")
+        self.step = int(step)
+        self.signal_name = signal_name
+        self.saved = bool(saved)
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT -> checkpoint-requested flag (main-thread install).
+
+    ``requested`` is the only thing hot paths read (one attribute load per
+    step boundary).  Signal counts accumulate handler-side and are drained
+    into the metrics registry by the trainer at safe points
+    (``drain_signal_count``) — never from the handler itself, which may be
+    interrupting a thread that holds the registry lock.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        # A plain bool, NOT a threading.Event: Event.set() takes the
+        # event's non-reentrant lock, and CPython delivers a nested signal
+        # at the next bytecode boundary — a second SIGTERM landing while
+        # the first handler sits inside set() would re-enter and deadlock
+        # the main thread on a lock it already holds, hanging the process
+        # until the scheduler's SIGKILL.  GIL-atomic attribute writes need
+        # no lock at all.
+        self._requested = False
+        self.signal_name: Optional[str] = None
+        self.signal_monotonic: Optional[float] = None
+        self.signal_count = 0
+        self._drained = 0
+        self._prev: Dict[int, object] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> "PreemptionHandler":
+        """Install the handlers; safe no-op (logged to stderr) off the main
+        thread, where CPython forbids ``signal.signal``."""
+        if threading.current_thread() is not threading.main_thread():
+            os.write(2, b"preemption handler not installed: "
+                        b"not on the main thread\n")
+            return self
+        for sig in self.SIGNALS:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the previous dispositions (idempotent)."""
+        prev, self._prev = self._prev, {}
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError, TypeError):
+                pass
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def drain_signal_count(self) -> int:
+        """Signals received since the last drain (for registry counters)."""
+        n = self.signal_count - self._drained
+        self._drained += n
+        return n
+
+    # -- the handler (async-signal context: flag + bookkeeping only) -------
+
+    def _handle(self, signum, frame) -> None:
+        self.signal_count += 1
+        if not self._requested:
+            self.signal_name = signal.Signals(signum).name
+            self.signal_monotonic = time.monotonic()
+            self._requested = True
+        if signum == signal.SIGINT:
+            # Second Ctrl-C must be a hard stop: hand SIGINT back to the
+            # previous disposition (normally KeyboardInterrupt).
+            try:
+                signal.signal(
+                    signal.SIGINT,
+                    self._prev.get(signal.SIGINT, signal.default_int_handler))
+            except (ValueError, OSError, TypeError):
+                pass
+        # Raw fd write, not logging: the interrupted thread may hold the
+        # logging lock (watchdog._die has the same rationale).
+        try:
+            os.write(2, (f"PREEMPT: {self.signal_name or signum} received; "
+                         "will checkpoint and exit at the next step "
+                         "boundary\n").encode())
+        except OSError:
+            pass

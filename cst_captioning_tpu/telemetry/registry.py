@@ -49,6 +49,16 @@ class MetricsRegistry:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def declare(self, *names: str) -> None:
+        """Pre-register counters at 0 (idempotent; never resets a live
+        count).  Rare-event counters — the preemption layer's
+        ``preempt_signals``/``preempt_saves`` — are declared at startup so
+        every snapshot/heartbeat carries them explicitly: a reader can
+        tell "armed, nothing happened" (0) from "feature absent"."""
+        with self._lock:
+            for name in names:
+                self._counters.setdefault(name, 0)
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
@@ -128,19 +138,9 @@ class MetricsRegistry:
 
     def write_snapshot(self, path: str) -> None:
         """Atomic telemetry.json write (the exit snapshot)."""
-        snap = self.snapshot()
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(snap, f, indent=2, default=str)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        # The rename is a directory-entry update a host crash can lose
-        # even after the data fsync above; best-effort, same pattern as
-        # checkpoint.py's infos.json.
-        from ..resilience.integrity import fsync_dir
+        from ..resilience.integrity import atomic_json_write
 
-        fsync_dir(os.path.dirname(os.path.abspath(path)))
+        atomic_json_write(path, self.snapshot(), indent=2, default=str)
 
     def close(self) -> None:
         for sink in self._sinks:

@@ -185,26 +185,8 @@ class CheckpointManager:
         # harness SIGKILL) can land mid-write, and a truncated infos.json
         # would turn the NEXT resume into a json.load crash — the recovery
         # mechanism bricking the run it exists to save.
-        tmp = self._infos_path + ".tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(self.infos, f, indent=2, default=str)
-                # fsync before rename: a host crash can journal the rename
-                # without the data, leaving an EMPTY infos.json — worse
-                # than the stale one the rename replaced.
-                f.flush()
-                os.fsync(f.fileno())
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        os.replace(tmp, self._infos_path)
-        # fsync the DIRECTORY too: the rename itself is a directory-entry
-        # update, and a power cut / SIGKILL can otherwise lose it even
-        # though the tmp file's data blocks were fsync'd above.
-        integrity.fsync_dir(self.directory)
+        integrity.atomic_json_write(self._infos_path, self.infos,
+                                    indent=2, default=str)
 
     def _scrub_infos_after_quarantine(self) -> None:
         """A quarantined step's bookkeeping must go with it: leaving its
@@ -260,9 +242,18 @@ class CheckpointManager:
                 log.warning("could not delete stale step %d cleanly: %s",
                             step, e)
 
-    def save_recovery(self, step: int, state) -> None:
-        """Periodic crash-recovery save (``--save_every_steps``): keeps only
-        the most recent one, never affects best-score bookkeeping."""
+    def save_recovery(self, step: int, state, verify: bool = False) -> None:
+        """Periodic crash-recovery save (``--save_every_steps`` /
+        ``--save_interval_secs``): keeps only the most recent one, never
+        affects best-score bookkeeping.
+
+        ``verify=True`` (the preemption boundary) re-reads the just-sealed
+        step through the integrity layer and RAISES if it does not verify:
+        a preempting trainer is about to exit with "resumable — checkpoint
+        advanced" semantics, and that claim must be proven before the
+        process stakes its exit code on it (an unverifiable save exits as
+        a plain failure instead, and resume falls back to the previous
+        verified step)."""
         mgr = self._recovery_mgr()
         self._clear_existing(mgr, step)
         with self._span("ckpt_commit", step=int(step), recovery=True):
@@ -276,6 +267,14 @@ class CheckpointManager:
             mgr.wait_until_finished()
             self._seal_step(step, recovery=True)
         self._inc("checkpoints_saved")
+        if verify:
+            status, detail = self._verify_dir(
+                self._step_dir(step, recovery=True))
+            if status != "verified":
+                raise RuntimeError(
+                    f"recovery checkpoint step {step} failed post-save "
+                    f"integrity verification ({status}: {detail}); "
+                    "refusing to exit as resumable on an unproven save")
 
     # -- integrity ---------------------------------------------------------
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import atexit
 import logging
 import os
+import signal
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -49,6 +50,7 @@ from ..parallel.dp import data_parallel_jit
 from ..parallel.mesh import batch_sharding, make_mesh
 from ..resilience.faults import FaultPlan
 from ..resilience.guard import DivergenceGuard
+from ..resilience.preemption import PreemptedExit, PreemptionHandler
 from ..telemetry import (
     JsonlSink,
     ScalarWriterSink,
@@ -174,8 +176,17 @@ class Trainer:
     KNOWN_EVAL_METRICS = ("CIDEr", "CIDEr-plain", "METEOR", "METEOR_approx",
                           "ROUGE_L", "Bleu_1", "Bleu_2", "Bleu_3", "Bleu_4")
 
-    def __init__(self, opt):
+    def __init__(self, opt, preemption: Optional[PreemptionHandler] = None):
         self.opt = opt
+        # Preemption layer (resilience/preemption.py): train.py installs
+        # the handler BEFORE this slow constructor and passes it in, so a
+        # SIGTERM landing during device bring-up / table upload is already
+        # caught; an embedded caller that passes None gets a Trainer-owned
+        # handler installed here (and uninstalled by close()).
+        self._preempt = preemption
+        self._preempt_owned = preemption is None
+        if self._preempt_owned:
+            self._preempt = PreemptionHandler().install()
         # Armed before ANY backend-touching op (even PRNGKey initializes
         # the device client, and a wedged transport blocks there): a train
         # stage launched into an already-dead tunnel must still die with
@@ -201,7 +212,10 @@ class Trainer:
         except BaseException:
             # A failed constructor must not leave the armed watchdog
             # ticking toward os._exit in a process that chose to continue
-            # (e.g. a REPL catching the ValueError below).
+            # (e.g. a REPL catching the ValueError below) — nor an owned
+            # signal handler pointing at a dead Trainer.
+            if self._preempt_owned:
+                self._preempt.uninstall()
             self._watchdog.stop()
             raise
 
@@ -222,6 +236,10 @@ class Trainer:
         # (metrics.jsonl, TB) attach at the end of _init, once the process
         # knows it is the pod's metrics writer.
         self._telemetry = Telemetry.from_opts(opt)
+        # Preemption counters are declared at 0 up front so every
+        # heartbeat/exit snapshot carries them: a reader can tell "armed,
+        # nothing happened" from "feature absent" (registry.declare).
+        self._telemetry.registry.declare("preempt_signals", "preempt_saves")
         if opt.eval_metric not in self.KNOWN_EVAL_METRICS:
             # Fail at startup, not after the first epoch's validation
             # silently scores 0.0 forever.
@@ -399,6 +417,13 @@ class Trainer:
         # rollback path follows (this session's native stack occasionally
         # garbles scalar fetches; RESILIENCE.md caveat).
         self._host_step = int(resume_step) if resume_step is not None else 0
+        # Step number of the newest durable checkpoint (host int): the
+        # preemption boundary skips its forced save when the state on disk
+        # is already current (e.g. the signal landed during the validate
+        # that followed an epoch-boundary save).  -1 = nothing saved yet.
+        self._last_saved_step = (int(resume_step) if resume_step is not None
+                                 else -1)
+        self._last_save_monotonic = time.monotonic()
         # Divergence-rollback target: a HOST-memory snapshot of the last
         # known-good state, refreshed at every checkpoint save (and here,
         # right after a resume — a fresh run deliberately has NO snapshot
@@ -977,6 +1002,46 @@ class Trainer:
         self.state, completed = self._rl_pipeline.drain(self.state)
         return [(c[0], m) for c, m in completed]
 
+    def _note_saved(self, step1: int) -> None:
+        """Bookkeeping after ANY durable checkpoint save: the preemption
+        boundary uses ``_last_saved_step`` to skip a redundant save, and
+        the ``--save_interval_secs`` cadence restarts its wall clock."""
+        self._last_saved_step = int(step1)
+        self._last_save_monotonic = time.monotonic()
+
+    def _honor_preemption(self, step: int, drain) -> None:
+        """Step-boundary half of the preemption contract (module docstring
+        of resilience/preemption.py): called when the handler's flag is
+        set.  Drains in-flight rollouts, forces a VERIFIED checkpoint save
+        through the normal manifest/integrity path (skipped when the
+        newest checkpoint already holds this step), stamps the preemption
+        counters, and raises :class:`PreemptedExit` — which train.py maps
+        to the taxonomy's resumable exit code."""
+        h = self._preempt
+        reg = self._telemetry.registry
+        reg.inc("preempt_signals", h.drain_signal_count())
+        saved = step != self._last_saved_step
+        if saved:
+            if self.opt.use_rl:
+                drain()  # the checkpoint must include every dispatched step
+            with self._telemetry.phase("ckpt"):
+                self.ckpt.save_recovery(step, self.state, verify=True)
+            self._note_saved(step)
+            reg.inc("preempt_saves")
+        if h.signal_monotonic is not None:
+            reg.set_gauge(
+                "preempt_exit_ms",
+                round((time.monotonic() - h.signal_monotonic) * 1e3, 3))
+        # Durable with the state it describes, like every checkpoint
+        # boundary — this is the last flush before the process exits.
+        self._telemetry.flush(fsync=True)
+        log.warning(
+            "preemption (%s) honored at step boundary %d: %s; exiting with "
+            "the resumable taxonomy code", h.signal_name, step,
+            "verified checkpoint saved" if saved
+            else "checkpoint already current")
+        raise PreemptedExit(step, h.signal_name or "signal", saved)
+
     def _snapshot_good_state(self, step: int) -> None:
         """Host-memory copy of the current state — the divergence guard's
         rollback target.  Called right after every checkpoint save (the
@@ -1094,6 +1159,17 @@ class Trainer:
     def train(self) -> Dict[str, Any]:
         opt = self.opt
         bpe = self.loader.batches_per_epoch
+        # Host-side loop position, never a device scalar fetch (_host_step
+        # note in _init): identical to state.step on a healthy stack.
+        start_step = self._host_step
+        # Data half of deterministic resume (loader.skip_batches): align
+        # the batch stream with the position the restored params were
+        # trained to, BEFORE the prefetch worker starts drawing — a
+        # resumed run then consumes the same batch sequence from
+        # start_step onward as an uninterrupted run of the same seed, so
+        # a preempted-and-resumed stage ends bit-identical to its twin.
+        if start_step > 0:
+            self.loader.skip_batches(start_step)
         # The loader itself (not iter(loader)) so the prefetch worker can
         # re-issue a failed next_batch: transient feature-read errors are
         # retried with backoff instead of poisoning the run.
@@ -1103,9 +1179,6 @@ class Trainer:
             feat_dtype=self._feat_dtype(),
             telemetry=self._telemetry,
         ))
-        # Host-side loop position, never a device scalar fetch (_host_step
-        # note in _init): identical to state.step on a healthy stack.
-        start_step = self._host_step
         total_steps = opt.max_epochs * bpe
         best = self.ckpt.infos.get("best_score")
         best = float("-inf") if best is None else float(best)
@@ -1130,6 +1203,11 @@ class Trainer:
             }
         self._log_t0 = time.time()
         self._captions_done = 0
+        # --save_interval_secs counts from the start of THIS process's
+        # loop, not from Trainer construction: device bring-up must not
+        # make the first wall-clock save fire on the first step.
+        self._last_save_monotonic = time.monotonic()
+        save_interval = float(getattr(opt, "save_interval_secs", 0.0) or 0.0)
 
         def drain_and_log():
             for k, m in self._rl_drain():
@@ -1151,6 +1229,18 @@ class Trainer:
             # val, and save all returned — one beat covers them all.
             self._watchdog.beat()
             self._progress_step = step  # host int, safe for describe()
+            # Step boundary: a preemption signal that arrived during the
+            # previous iteration (or during init) is honored HERE — save,
+            # count, and exit resumable (raises PreemptedExit).
+            if self._preempt is not None and self._preempt.requested:
+                self._honor_preemption(step, drain_and_log)
+            if self._faults is not None and self._faults.fire("preempt",
+                                                              step):
+                log.warning("FAULT: preempt at step %d — delivering a real "
+                            "SIGTERM to pid %d (the boundary above must "
+                            "checkpoint and exit next pass)", step + 1,
+                            os.getpid())
+                os.kill(os.getpid(), signal.SIGTERM)
             if self._faults is not None and self._faults.fire("wedge", step):
                 log.critical("FAULT: wedge at step %d — blocking the train "
                              "loop (the watchdog must turn this into exit "
@@ -1200,8 +1290,15 @@ class Trainer:
                     step = rewind
                     continue
 
-            if (opt.save_every_steps
-                    and (step + 1) % opt.save_every_steps == 0
+            # Recovery-save cadence: step-based (--save_every_steps) OR
+            # wall-clock (--save_interval_secs — long CST stages bound
+            # preemption/crash loss by TIME even when step rate drifts).
+            due_steps = (opt.save_every_steps
+                         and (step + 1) % opt.save_every_steps == 0)
+            due_time = (save_interval > 0
+                        and time.monotonic() - self._last_save_monotonic
+                        >= save_interval)
+            if ((due_steps or due_time)
                     and (step + 1) % bpe != 0):  # epoch boundary saves below
                 if opt.use_rl:
                     drain_and_log()  # checkpoint must include all updates
@@ -1211,6 +1308,7 @@ class Trainer:
                 # is-None branch.
                 with self._telemetry.phase("ckpt"):
                     self.ckpt.save_recovery(step + 1, self.state)
+                self._note_saved(step + 1)
                 self._snapshot_good_state(step + 1)
                 # Checkpoint boundary: make the metrics stream durable with
                 # the state it describes (schema-2 contract, ISSUE 2).
@@ -1247,6 +1345,7 @@ class Trainer:
                                        extra={"opt": vars(opt),
                                               "val_scores": scores,
                                               "patience": patience})
+                    self._note_saved(step + 1)
                     self._snapshot_good_state(step + 1)
                     self._telemetry.flush(fsync=True)  # durable with state
                     self._watchdog.beat()  # orbax fetch+write completed
@@ -1263,6 +1362,7 @@ class Trainer:
                 else:
                     with self._telemetry.phase("ckpt"):
                         self.ckpt.save(step + 1, self.state)
+                    self._note_saved(step + 1)
                     self._snapshot_good_state(step + 1)
                     self._telemetry.flush(fsync=True)
             step += 1
@@ -1313,5 +1413,10 @@ class Trainer:
         finally:
             # Always disarm, even if a close above raised — an embedded
             # caller that catches the error must not be os._exit'd by a
-            # still-armed watchdog minutes later.
+            # still-armed watchdog minutes later.  Same rule for a
+            # Trainer-OWNED preemption handler: restore the process's
+            # previous signal dispositions (train.py keeps its own handler
+            # armed through its exit path).
+            if self._preempt_owned and self._preempt is not None:
+                self._preempt.uninstall()
             self._watchdog.stop()

@@ -25,15 +25,19 @@ failure to surface.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..resilience.exitcodes import EXIT_WEDGE
+from ..resilience.integrity import atomic_json_write
+
 #: Exit status for "no progress within the timeout" — matches coreutils
 #: ``timeout(1)`` so shell-level and watchdog-level wedge kills look alike.
-WEDGE_EXIT_CODE = 124
+#: Canonical home: the resilience exit-code taxonomy (resilience/
+#: exitcodes.py); re-exported here for the many existing importers.
+WEDGE_EXIT_CODE = EXIT_WEDGE
 
 
 class ProgressWatchdog:
@@ -110,12 +114,13 @@ class ProgressWatchdog:
                    "timeout_s": self.timeout_s}
             if self._payload is not None:
                 doc.update(self._payload() or {})
-            os.makedirs(os.path.dirname(
-                os.path.abspath(self._heartbeat_path)), exist_ok=True)
-            tmp = self._heartbeat_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(doc, f, default=str)
-            os.replace(tmp, self._heartbeat_path)
+            target = os.path.abspath(self._heartbeat_path)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            # Durable-JSON discipline (fsync'd tmp + atomic rename + dir
+            # fsync): a kill landing mid-write must never leave a TORN
+            # heartbeat for the harness to misread as garbage.  Polls are
+            # seconds apart, so the fsyncs are noise-level cost.
+            atomic_json_write(target, doc, default=str)
         except Exception:
             pass  # best-effort: a full disk must not look like a wedge
 

@@ -25,9 +25,14 @@ kills the stage (exit 124) instead of hanging it.  The harness then polls
 the device with fresh probe processes until the transport heals and
 re-runs the stage, which auto-resumes from its newest checkpoint (the
 2026-07-31 field pattern: the tunnel flaps on a scale of tens of minutes
-to hours, and a chain left unattended must survive that).  A stage that
-fails while the device probe SUCCEEDS is a real failure and aborts the
-chain — retrying can only hide it.
+to hours, and a chain left unattended must survive that).  Stage exits
+are classified through the resilience exit-code taxonomy
+(cst_captioning_tpu/resilience/exitcodes.py): RESUMABLE exits — 75
+(preempted: the trainer checkpointed at a step boundary and asked to be
+restarted), 143/137 (external kills) — restart immediately without a
+device probe, and a preempt exit's checkpoint advance counts as
+progress.  A FATAL exit while the device probe SUCCEEDS is a real
+failure and aborts the chain — retrying can only hide it.
 """
 
 from __future__ import annotations
@@ -43,6 +48,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from cst_captioning_tpu.resilience import exitcodes  # noqa: E402
 from cst_captioning_tpu.utils.platform import run_in_group  # noqa: E402
 from cst_captioning_tpu.utils.watchdog import WEDGE_EXIT_CODE  # noqa: E402
 
@@ -165,17 +171,32 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
 
     healthy_timeouts = 0
     no_progress = 0
+    last_rc = None
     last_fp = fingerprint() if fingerprint else None
     attempt = 0
     while True:
         if no_progress >= max_attempts:
+            # Diagnose by what the attempts actually died OF: the
+            # resumable branch never probes the device, so "the device
+            # stayed healthy" / "raise --wedge_timeout" would be the
+            # wrong remediation for an exit-at-startup loop.
+            if (last_rc is not None
+                    and exitcodes.classify(last_rc) == exitcodes.RESUMABLE):
+                why = (f"every attempt exited resumable (last: "
+                       f"{exitcodes.describe(last_rc)}) without advancing "
+                       "its checkpoint — an exit-during-startup loop (OOM "
+                       "kill, preemption storm), not a wedge; fix the "
+                       "external cause and rerun, the newest checkpoint "
+                       "is intact")
+            else:
+                why = ("the device stayed healthy — if each died at exit "
+                       "124 at the same point, a legitimate blocking phase "
+                       "(first compile/upload) likely exceeds "
+                       "--wedge_timeout; raise it rather than retrying")
             raise abort(
                 "no_progress_cap",
                 f"stage {tag}: {no_progress} consecutive attempts made no "
-                "on-disk progress while the device stayed healthy — if "
-                "each died at exit 124 at the same point, a legitimate "
-                "blocking phase (first compile/upload) likely exceeds "
-                "--wedge_timeout; raise it rather than retrying")
+                f"on-disk progress; {why}")
         attempt += 1
         if attempt > 1:
             print(f"=== {tag}: attempt {attempt} (resume; {no_progress} "
@@ -199,6 +220,10 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
             progressed, last_fp = fp != last_fp, fp
         events.emit("attempt_exit", tag=tag, attempt=attempt, rc=rc,
                     timed_out=timed_out, progressed=progressed)
+        # Exit-code taxonomy (resilience/exitcodes.py): what the rc MEANS
+        # decides the response, instead of pattern-matching magic numbers.
+        category = exitcodes.classify(rc)
+        last_rc = rc
         # One probe decides this attempt's classification; the heal loop
         # below reuses that verdict for its first wait instead of
         # immediately spawning a second backend-init probe at a device we
@@ -230,13 +255,33 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                         "--eval_timeout) instead of retrying")
                 continue
             known_wedged = True
-        elif rc != WEDGE_EXIT_CODE:
+        elif category == exitcodes.RESUMABLE:
+            # The stage exited by choice or external kill with its
+            # checkpoint intact: 75 (preempted) means the trainer SAVED a
+            # verified checkpoint before exiting — the fingerprint
+            # advances and the attempt counts as progress instead of
+            # burning the no-progress cap; 143/137 (unhandled
+            # SIGTERM/SIGKILL) resume from the newest checkpoint the same
+            # way.  No device probe: the exit came from the process, not
+            # from a wedged transport.
+            print(f"=== {tag}: resumable exit rc={rc} "
+                  f"({exitcodes.describe(rc)}); restarting ===", flush=True)
+            events.emit("resumable_exit", tag=tag, rc=rc,
+                        preempted=(exitcodes.normalize(rc)
+                                   == exitcodes.EXIT_PREEMPTED),
+                        progressed=progressed)
+            if progressed:
+                no_progress, healthy_timeouts = 0, 0
+            else:
+                no_progress += 1
+            continue
+        elif category != exitcodes.WEDGE:
             if probe() == "ok":
                 raise abort(
                     "real_failure",
-                    f"stage {tag} failed with rc={rc} while the device "
-                    "probe succeeds — a real failure, not a wedge; "
-                    "aborting")
+                    f"stage {tag} failed with rc={rc} "
+                    f"({exitcodes.describe(rc)}) while the device probe "
+                    "succeeds — a real failure, not a wedge; aborting")
             known_wedged = True
         print(f"=== {tag}: wedge (rc={rc}); polling for the device "
               f"every {wedge_poll_s:.0f}s ===", flush=True)

@@ -1,0 +1,346 @@
+"""Preemption layer units: exit-code taxonomy, signal-flag handler,
+harness classification, and the doc tables pinned to the code.
+
+The tier-1-safe slice of ISSUE 4: everything here runs in-process in
+milliseconds (no jax backend, no subprocess training).  The end-to-end
+drills — real SIGTERM through the train.py CLI, boundary save, taxonomy
+exit, bit-exact resume — live in tests/test_resilience.py
+(``TestPreemptionEndToEnd``, marked ``slow``; ``make chaos`` runs them).
+"""
+
+import importlib.util
+import os
+import signal
+import sys
+import threading
+
+import pytest
+
+from cst_captioning_tpu.resilience import exitcodes
+from cst_captioning_tpu.resilience.exitcodes import (
+    EXIT_ADVANTAGE_ABORT,
+    EXIT_PREEMPTED,
+    EXIT_WEDGE,
+    classify,
+    describe,
+    normalize,
+)
+from cst_captioning_tpu.resilience.preemption import (
+    PreemptedExit,
+    PreemptionHandler,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- exit-code taxonomy ----------------------------------------------------
+
+class TestExitcodeTaxonomy:
+    def test_catalogued_codes_classify(self):
+        assert classify(0) == "ok"
+        assert classify(1) == "fatal"
+        assert classify(2) == "fatal"                 # argparse usage
+        assert classify(EXIT_ADVANTAGE_ABORT) == "fatal"
+        assert classify(EXIT_PREEMPTED) == "resumable"
+        assert classify(EXIT_WEDGE) == "wedge"
+        assert classify(130) == "fatal"               # operator Ctrl-C
+        assert classify(137) == "resumable"           # external SIGKILL
+        assert classify(143) == "resumable"           # unhandled SIGTERM
+
+    def test_negative_subprocess_form_normalizes(self):
+        """subprocess reports death-by-signal as -signum; the shell as
+        128+signum.  Both spellings of one death must classify alike."""
+        assert normalize(-signal.SIGTERM) == 143
+        assert normalize(-signal.SIGKILL) == 137
+        assert classify(-signal.SIGTERM) == classify(143)
+        assert classify(-signal.SIGSEGV) == "resumable"  # external kill
+
+    def test_uncatalogued_codes(self):
+        # Died to an uncatalogued signal: proves nothing about the stage.
+        assert classify(128 + signal.SIGSEGV) == "resumable"
+        assert classify(128 + signal.SIGBUS) == "resumable"
+        # Ordinary unknown exits: surface, never auto-retry.
+        assert classify(3) == "fatal"
+        assert classify(77) == "fatal"
+        assert classify(255) == "fatal"
+
+    def test_constants_are_catalogued_and_consistent(self):
+        """Every importable EXIT_* constant must appear in CODES with the
+        category classify() reports — the table IS the taxonomy."""
+        for name, rc in vars(exitcodes).items():
+            if name.startswith("EXIT_"):
+                assert rc in exitcodes.CODES, f"{name} missing from CODES"
+                assert classify(rc) == exitcodes.CODES[rc].category
+
+    def test_describe_is_human_one_liner(self):
+        assert "preempted" in describe(EXIT_PREEMPTED)
+        assert "\n" not in describe(EXIT_PREEMPTED)
+        assert "resumable" in describe(150)       # uncatalogued signal
+        assert "fatal" in describe(77)
+        assert "signal" in describe(-11)
+
+
+# -- the signal-flag handler -----------------------------------------------
+
+class TestPreemptionHandler:
+    def test_sigterm_sets_flag_and_counts(self):
+        h = PreemptionHandler().install()
+        try:
+            assert not h.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested
+            assert h.signal_name == "SIGTERM"
+            assert h.signal_monotonic is not None
+            assert h.drain_signal_count() == 1
+            assert h.drain_signal_count() == 0, "drain must be incremental"
+            # Repeated TERMs during the grace window are absorbed, counted.
+            os.kill(os.getpid(), signal.SIGTERM)
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested
+            assert h.drain_signal_count() == 2
+        finally:
+            h.uninstall()
+
+    def test_uninstall_restores_previous_dispositions(self):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        h = PreemptionHandler().install()
+        assert signal.getsignal(signal.SIGTERM) == h._handle
+        h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
+        h.uninstall()  # idempotent
+
+    def test_first_sigint_is_graceful_second_is_hard(self):
+        """Interactive contract: the FIRST Ctrl-C requests the graceful
+        checkpoint-and-exit; the handler then hands SIGINT back to the
+        previous disposition so a second Ctrl-C stops the run hard."""
+        prev_int = signal.getsignal(signal.SIGINT)
+        h = PreemptionHandler().install()
+        try:
+            os.kill(os.getpid(), signal.SIGINT)
+            assert h.requested and h.signal_name == "SIGINT"
+            # The next SIGINT now goes to the PREVIOUS handler, not ours.
+            assert signal.getsignal(signal.SIGINT) == prev_int
+        finally:
+            h.uninstall()
+        assert signal.getsignal(signal.SIGINT) == prev_int
+
+    def test_install_off_main_thread_is_safe_noop(self):
+        h = PreemptionHandler()
+        before = signal.getsignal(signal.SIGTERM)
+        t = threading.Thread(target=h.install)
+        t.start()
+        t.join()
+        assert signal.getsignal(signal.SIGTERM) == before
+        h.uninstall()
+
+    def test_preempted_exit_carries_the_story(self):
+        e = PreemptedExit(42, "SIGTERM", True)
+        assert e.step == 42 and e.saved and e.signal_name == "SIGTERM"
+        assert "step 42" in str(e) and "saved" in str(e)
+        assert "already current" in str(PreemptedExit(7, "SIGINT", False))
+
+
+# -- registry declare (rare-event counters visible at 0) -------------------
+
+class TestDeclaredCounters:
+    def test_declare_registers_zero_without_resetting(self):
+        from cst_captioning_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.declare("preempt_signals", "preempt_saves")
+        assert reg.counter("preempt_saves") == 0
+        reg.inc("preempt_signals", 3)
+        reg.declare("preempt_signals")  # re-declare must NOT reset
+        assert reg.counter("preempt_signals") == 3
+        snap = reg.snapshot()
+        assert snap["counters"]["preempt_saves"] == 0
+        assert snap["counters"]["preempt_signals"] == 3
+        hb = reg.heartbeat_payload()
+        assert hb["counters"]["preempt_saves"] == 0
+
+
+# -- harness classification (scale_chain.run_stage) ------------------------
+
+def _load_scale_chain():
+    spec = importlib.util.spec_from_file_location(
+        "scale_chain", os.path.join(REPO, "scripts", "scale_chain.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+PREEMPT_ONCE = """\
+import json, os, sys
+stage = sys.argv[1]
+os.makedirs(os.path.join(stage, "recovery"), exist_ok=True)
+steps = [e for e in os.listdir(os.path.join(stage, "recovery"))
+         if e.isdigit()]
+if not steps:
+    # first attempt: "preempted" — checkpoint advanced, exit resumable
+    os.makedirs(os.path.join(stage, "recovery", "5"))
+    with open(os.path.join(stage, "infos.json"), "w") as f:
+        json.dump({"last_step": 5}, f)
+    sys.exit(75)
+sys.exit(0)
+"""
+
+
+class TestRunStageTaxonomy:
+    def test_preempt_exit_restarts_without_probe_or_attempt_burn(
+            self, tmp_path, capsys):
+        """A 75 with an advanced fingerprint counts as PROGRESS: no device
+        probe, no no-progress attempt consumed, immediate restart."""
+        sc = _load_scale_chain()
+        script = tmp_path / "preempt_once.py"
+        script.write_text(PREEMPT_ONCE)
+        stage = tmp_path / "stage"
+        stage.mkdir()
+        events = []
+
+        class Log:
+            def emit(self, event, **fields):
+                events.append({"event": event, **fields})
+
+        # max_attempts=1: if the preempt exit consumed a no-progress
+        # attempt, the SECOND pass would hit the cap and abort — finishing
+        # proves the checkpoint-advanced restart is free.
+        sc.run_stage("pre", [sys.executable, str(script), str(stage)],
+                     max_attempts=1, wedge_poll_s=0.1, max_wedge_wait_s=30.0,
+                     probe_timeout_s=20.0, env=_cpu_env(),
+                     fingerprint=sc.stage_fingerprint(str(stage)),
+                     events=Log())
+        kinds = [e["event"] for e in events]
+        assert "resumable_exit" in kinds
+        assert "probe" not in kinds, "resumable exits must not device-probe"
+        assert "stage_done" in kinds
+        res = next(e for e in events if e["event"] == "resumable_exit")
+        assert res["rc"] == 75 and res["preempted"] and res["progressed"]
+        out = capsys.readouterr().out
+        assert "resumable exit rc=75" in out
+
+    def test_repeated_preempt_without_progress_hits_cap(self, tmp_path):
+        """A stage that exits 75 forever WITHOUT advancing its checkpoint
+        (pathological) must still be bounded by the no-progress cap, not
+        loop eternally."""
+        sc = _load_scale_chain()
+        script = tmp_path / "always75.py"
+        script.write_text("import sys; sys.exit(75)\n")
+        # The cap's diagnosis must name what the attempts died OF (an
+        # exit-at-startup loop), not the wedge/--wedge_timeout story —
+        # the resumable path never probed the device.
+        with pytest.raises(SystemExit,
+                           match="no on-disk progress.*exited resumable"):
+            sc.run_stage("pre75", [sys.executable, str(script)],
+                         max_attempts=2, wedge_poll_s=0.1,
+                         max_wedge_wait_s=30.0, probe_timeout_s=20.0,
+                         env=_cpu_env())
+
+    def test_external_sigterm_death_is_retried_as_resumable(self, tmp_path):
+        """143 (SIGTERM death without the graceful handler — eval stages,
+        or a kill during unwinding) resumes from checkpoint instead of
+        aborting as a real failure."""
+        sc = _load_scale_chain()
+        script = tmp_path / "term_once.py"
+        marker = tmp_path / "attempted"
+        script.write_text(
+            "import os, signal, sys\n"
+            "m = sys.argv[1]\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').close()\n"
+            "    signal.signal(signal.SIGTERM, signal.SIG_DFL)\n"
+            "    os.kill(os.getpid(), signal.SIGTERM)\n"
+            "sys.exit(0)\n")
+        sc.run_stage("term", [sys.executable, str(script), str(marker)],
+                     max_attempts=3, wedge_poll_s=0.1, max_wedge_wait_s=30.0,
+                     probe_timeout_s=20.0, env=_cpu_env())
+        assert marker.exists()
+
+    def test_fatal_codes_still_abort(self, tmp_path):
+        """The taxonomy must not soften real failures: an advantage abort
+        (4) aborts the chain on a healthy device, exactly like 1/2."""
+        sc = _load_scale_chain()
+        script = tmp_path / "abort4.py"
+        script.write_text("import sys; sys.exit(4)\n")
+        with pytest.raises(SystemExit, match="real failure"):
+            sc.run_stage("adv", [sys.executable, str(script)],
+                         max_attempts=3, wedge_poll_s=0.1,
+                         max_wedge_wait_s=30.0, probe_timeout_s=20.0,
+                         env=_cpu_env())
+
+
+# -- harness e2e: scale_chain rides through a real preemption --------------
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_scale_chain_rides_through_preemption(tmp_path):
+    """The whole loop at the harness level: a micro chain whose XE stage
+    is preempted by a real SIGTERM (`preempt@step=0`) must be restarted by
+    scale_chain as a resumable exit — no device probe, no abort — and the
+    chain must complete with the stage's full step count on disk."""
+    import json
+    import subprocess
+
+    from conftest import CACHE_DIR
+
+    env = _cpu_env()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    out = tmp_path / "chain"
+    proc = subprocess.run(
+        [sys.executable, "scripts/scale_chain.py", "--out_dir", str(out),
+         "--stages", "xe",
+         "--num_videos", "6", "--num_val", "4", "--batch_size", "2",
+         "--rnn_size", "32", "--rich_vocab", "60",
+         "--feat_dims", "16", "16", "--feat_times", "4", "1",
+         "--xe_epochs", "1", "--patience", "0",
+         "--max_stage_attempts", "6",
+         "--fault_plan", "preempt@step=0"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:{proc.stdout[-3000:]}\n"
+        f"stderr:{proc.stderr[-3000:]}")
+    assert "resumable exit rc=75" in proc.stdout
+
+    events = [json.loads(line) for line in
+              (out / "chain_events.jsonl").read_text().splitlines()]
+    resumable = [e for e in events if e["event"] == "resumable_exit"]
+    assert resumable and resumable[0]["rc"] == 75 and resumable[0]["preempted"]
+    # The preempt exit's boundary save registered as on-disk progress.
+    assert resumable[0]["progressed"]
+    assert "stage_done" in [e["event"] for e in events]
+    # No stage_abort: the preemption never read as a real failure.
+    assert not [e for e in events if e["event"] == "stage_abort"]
+    with open(out / "checkpoints" / "xe" / "infos.json") as f:
+        assert json.load(f)["last_step"] == 3  # 6 videos / batch 2 x 1 epoch
+
+
+# -- docs pinned to the code -----------------------------------------------
+
+class TestDocsStayInSync:
+    def test_resilience_md_exit_code_table_matches_codes(self):
+        """RESILIENCE.md's exit-code table is sourced from
+        exitcodes.CODES: every catalogued code must appear with its name
+        and classification, so docs and taxonomy cannot drift."""
+        with open(os.path.join(REPO, "RESILIENCE.md")) as f:
+            doc = f.read()
+        for rc, code in exitcodes.CODES.items():
+            assert f"`{rc}`" in doc, f"exit code {rc} missing from doc table"
+            assert code.name in doc, f"{code.name} missing from doc table"
+
+    def test_resilience_md_documents_preemption(self):
+        with open(os.path.join(REPO, "RESILIENCE.md")) as f:
+            doc = f.read()
+        assert "preempt@step=" in doc, "fault grammar must list preempt"
+        assert "preemption" in doc.lower()
+        assert "--save_interval_secs" in doc
+        assert "skip_batches" in doc, "deterministic-resume note missing"

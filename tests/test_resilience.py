@@ -14,6 +14,7 @@ the subprocess wedge drill is ``slow`` and runs under ``make chaos``.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -64,6 +65,51 @@ class TestFaultPlan:
     def test_bad_specs_fail_at_parse(self, bad):
         with pytest.raises(ValueError):
             FaultPlan.parse(bad)
+
+    @pytest.mark.parametrize("bad", ["nan_grad@stp=3", "wedge@step"])
+    def test_parse_error_is_single_line_naming_token_and_grammar(self, bad):
+        """The error an operator actually reads: ONE line, quoting the bad
+        token, stating the grammar — not a traceback to decode."""
+        with pytest.raises(ValueError) as ei:
+            FaultPlan.parse(bad)
+        msg = str(ei.value)
+        assert bad in msg, "message must name the offending token"
+        assert "\n" not in msg, "must be a single line"
+        assert "kind@step=N" in msg, "message must state the grammar"
+
+    @pytest.mark.parametrize("bad", ["nan_grad@stp=3", "wedge@step"])
+    def test_cli_rejects_malformed_plan_as_usage_error(self, bad, capsys):
+        """--fault_plan validates at argparse time (opts.py): a malformed
+        spec exits 2 with a usage line naming the token, instead of
+        surfacing as a Trainer-startup ValueError traceback."""
+        from cst_captioning_tpu.opts import parse_opts
+
+        with pytest.raises(SystemExit) as ei:
+            parse_opts(["--fault_plan", bad])
+        assert ei.value.code == 2
+        err = capsys.readouterr().err
+        assert bad in err and "--fault_plan" in err
+        assert "Traceback" not in err
+
+    def test_env_var_plan_gets_the_same_usage_error(self, capsys,
+                                                    monkeypatch):
+        """The CST_FAULT_PLAN fallback is resolved as the argparse DEFAULT
+        (opts.py), so a malformed env plan exits 2 with the same one-line
+        usage error as a malformed flag — never a Trainer-startup
+        traceback; a well-formed env plan lands in the namespace."""
+        from cst_captioning_tpu.opts import parse_opts
+
+        monkeypatch.setenv("CST_FAULT_PLAN", "nan_grad@stp=3")
+        with pytest.raises(SystemExit) as ei:
+            parse_opts([])
+        assert ei.value.code == 2
+        err = capsys.readouterr().err
+        assert "nan_grad@stp=3" in err and "Traceback" not in err
+
+        monkeypatch.setenv("CST_FAULT_PLAN", "wedge@step=7")
+        assert parse_opts([]).fault_plan == "wedge@step=7"
+        monkeypatch.setenv("CST_FAULT_PLAN", "")
+        assert parse_opts([]).fault_plan is None
 
     def test_fire_is_single_shot_per_index(self):
         plan = FaultPlan.parse("nan_grad@step=5*2")
@@ -201,6 +247,40 @@ class TestCheckpointManagerIntegrity:
             mgr.restore(state, step=2)
         mgr.close()
 
+    def test_walk_back_past_two_consecutive_torn_steps(self, tmp_path, state):
+        """PR 1 pinned a single torn newest step; a crash storm (or a
+        dying disk) can tear SEVERAL saves in a row.  Resolution must walk
+        back past every consecutive corrupt step to the oldest good one,
+        and a fresh manager must quarantine them all at startup."""
+        import jax.numpy as jnp
+
+        from cst_captioning_tpu.training.checkpoint import CheckpointManager
+
+        d = str(tmp_path / "ck")
+        mgr = CheckpointManager(d, max_to_keep=4)
+        for s, score in ((1, 0.1), (2, 0.2), (3, 0.3)):
+            mgr.save(s, state.replace(step=jnp.asarray(s)), score=score)
+        CheckpointManager._tear_step(mgr._step_dir(2))
+        CheckpointManager._tear_step(mgr._step_dir(3))
+        # Same-process view: both newest steps corrupt, walk-back lands on 1.
+        assert mgr.verify_step(3)[0] == "corrupt"
+        assert mgr.verify_step(2)[0] == "corrupt"
+        assert mgr.latest_verified_step == 1
+        restored = mgr.restore(state)  # walks back 3 -> 2 -> 1
+        assert int(restored.step) == 1
+        mgr.close()
+        # Fresh-process view (the resume shape): startup quarantine moves
+        # BOTH torn steps aside and best bookkeeping falls to the oldest
+        # good scored step.
+        mgr2 = CheckpointManager(d, max_to_keep=4)
+        assert os.path.isdir(os.path.join(d, "2.corrupt-quarantine"))
+        assert os.path.isdir(os.path.join(d, "3.corrupt-quarantine"))
+        assert mgr2.latest_verified_step == 1
+        assert mgr2.best_step == 1
+        assert mgr2.infos["best_score"] == 0.1
+        assert set(mgr2.infos.get("step_scores", {})) == {"1"}
+        mgr2.close()
+
     def test_ckpt_torn_fault_hook_tears_after_manifest(self, tmp_path, state):
         from cst_captioning_tpu.training.checkpoint import CheckpointManager
 
@@ -256,6 +336,24 @@ class TestCheckpointManagerIntegrity:
         assert mgr2.infos["best_score"] == 0.5
         assert "2" not in mgr2.infos.get("step_scores", {})
         assert os.path.isdir(os.path.join(d, "2.corrupt-quarantine"))
+        mgr2.close()
+
+    def test_verified_recovery_save_refuses_torn_write(self, tmp_path, state):
+        """save_recovery(verify=True) — the preemption boundary's save —
+        must RAISE when the just-sealed step does not verify, instead of
+        letting the process exit 'resumable: checkpoint advanced' on a
+        checkpoint that cannot restore."""
+        from cst_captioning_tpu.training.checkpoint import CheckpointManager
+
+        plan = FaultPlan.parse("ckpt_torn@step=1")
+        mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=4,
+                                fault_plan=plan)
+        with pytest.raises(RuntimeError, match="post-save"):
+            mgr.save_recovery(1, state, verify=True)
+        mgr.close()
+        # The clean path verifies and returns.
+        mgr2 = CheckpointManager(str(tmp_path / "ck2"), max_to_keep=4)
+        mgr2.save_recovery(1, state, verify=True)
         mgr2.close()
 
     def test_verification_cache_sees_external_tamper(self, tmp_path, state):
@@ -452,6 +550,53 @@ class TestPrefetchResilience:
         next(it)
         with pytest.raises(OSError):
             next(it)
+
+
+# -- deterministic-resume data alignment -----------------------------------
+
+class TestResumeStreamAlignment:
+    """loader.skip_batches is the data half of bit-exact resume: a
+    fast-forwarded stream must serve the SAME batches (video order, epoch
+    shuffles, per-video caption draws) as one that actually served the
+    skipped prefix."""
+
+    def _loader(self, data):
+        from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+        from cst_captioning_tpu.data.loader import CaptionLoader
+
+        t = data["train"]
+        ds = CaptionDataset(SplitPaths(feat_h5=json.loads(t["feat_h5"]),
+                                       label_h5=t["label_h5"],
+                                       info_json=t["info_json"]))
+        return ds, lambda: CaptionLoader(ds, batch_size=2, seq_per_img=2,
+                                         shuffle=True, seed=0)
+
+    def test_skip_batches_matches_served_stream(self, data):
+        ds, mk = self._loader(data)
+        try:
+            full = mk()
+            served = [full.next_batch() for _ in range(6)]  # 3 tiny epochs
+            for n in (1, 2, 3, 5):  # mid-epoch AND boundary skips
+                fast = mk()
+                fast.skip_batches(n)
+                for i in range(n, 6):
+                    got = fast.next_batch()
+                    want = served[i]
+                    assert got.video_ids == want.video_ids, (n, i)
+                    np.testing.assert_array_equal(got.labels, want.labels)
+                    np.testing.assert_array_equal(got.weights, want.weights)
+        finally:
+            ds.close()
+
+    def test_skip_zero_or_negative_is_noop(self, data):
+        ds, mk = self._loader(data)
+        try:
+            a, b = mk(), mk()
+            b.skip_batches(0)
+            b.skip_batches(-3)
+            assert a.next_batch().video_ids == b.next_batch().video_ids
+        finally:
+            ds.close()
 
 
 # -- e2e chaos: the real trainer through injected faults -------------------
@@ -746,6 +891,259 @@ class TestChaosEndToEnd:
                     m[rec["step"]] += 1
         assert m[1] == 2 and m[2] == 2, dict(m)
         assert infos(ck)["last_step"] == 2
+
+
+# -- preemption drills (subprocess; signal -> boundary save -> exit 75) ----
+
+@pytest.fixture(scope="module")
+def twin_run(data, tmp_path_factory):
+    """Uninterrupted reference run (same seed/config as the drills): the
+    preempted-and-resumed runs must reproduce its metrics stream — and its
+    final params — bit-for-bit."""
+    ck = str(tmp_path_factory.mktemp("twin") / "xe")
+    proc = run_train_cli(data, ck)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return ck
+
+
+def _summary_json(proc):
+    for line in reversed(proc.stdout.splitlines()):
+        if line.strip().startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"no summary JSON on stdout: {proc.stdout!r}")
+
+
+def _skip_if_native_restore_death(proc):
+    """The documented environment defect (RESILIENCE.md caveat): a process
+    that orbax-restores and keeps training can die in tensorstore with a
+    signal.  The preemption semantics under test are asserted from durable
+    artifacts BEFORE this call; only the clean-completion half is skipped,
+    and only on that exact signature."""
+    if proc.returncode < 0:
+        pytest.skip("documented native restore instability (RESILIENCE.md): "
+                    f"resumed child died with signal {-proc.returncode}; "
+                    f"stderr tail: {proc.stderr.strip()[-160:]}")
+
+
+PARAMS_COMPARE = """\
+import sys
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+a = ocp.StandardCheckpointer().restore(sys.argv[1])
+b = ocp.StandardCheckpointer().restore(sys.argv[2])
+la = jax.tree_util.tree_leaves(a)
+lb = jax.tree_util.tree_leaves(b)
+assert len(la) == len(lb), (len(la), len(lb))
+if all(np.array_equal(np.asarray(x), np.asarray(y))
+       for x, y in zip(la, lb)):
+    print("PARAMS_IDENTICAL")
+else:
+    print("PARAMS_DIFFER")
+"""
+
+
+def _assert_params_bit_identical(tmp_path, ck_a, ck_b, step):
+    """Compare the two runs' step-``step`` params trees in a FRESH
+    subprocess (orbax restore is contained, per the RESILIENCE.md caveat);
+    a child killed by the documented native defect skips, a PARAMS_DIFFER
+    verdict fails."""
+    script = tmp_path / "params_compare.py"
+    script.write_text(PARAMS_COMPARE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(script),
+         os.path.join(ck_a, str(step), "params"),
+         os.path.join(ck_b, str(step), "params")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    if proc.returncode < 0:
+        pytest.skip("documented native restore instability: params "
+                    f"comparator died with signal {-proc.returncode}")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "PARAMS_IDENTICAL" in proc.stdout, \
+        "resumed run's final params differ from the uninterrupted twin's"
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+class TestPreemptionEndToEnd:
+    """The full preemption cycle over the real train.py CLI: a REAL
+    SIGTERM (delivered by the preempt fault kind) -> checkpoint-requested
+    flag -> boundary save through the manifest/integrity path -> exit with
+    the taxonomy's resumable code -> fresh-process resume that ends
+    bit-identical to an uninterrupted run of the same seed/config."""
+    # 4 videos / batch 2 -> bpe 2; 2 epochs -> 4 steps total.
+
+    def test_preempt_fault_saves_verified_checkpoint_and_exits_75(
+            self, data, tmp_path, twin_run):
+        from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+
+        ck = str(tmp_path / "preempt")
+        proc = run_train_cli(data, ck,
+                             **{"--fault_plan": ["preempt@step=0"]})
+        assert proc.returncode == EXIT_PREEMPTED, (
+            f"rc={proc.returncode}\n{proc.stderr[-2000:]}")
+        assert "Traceback" not in proc.stderr
+        assert "FAULT INJECTED: preempt" in proc.stderr
+        assert "preemption (SIGTERM) honored at step boundary 1" \
+            in proc.stderr
+        summary = _summary_json(proc)
+        assert summary == {"preempted": "SIGTERM", "step": 1, "saved": True,
+                           "checkpoint_path": ck}
+        # The boundary save went through the integrity path and verifies.
+        assert verify_step_dir(os.path.join(ck, "recovery", "1"))[0] \
+            == "verified"
+        # Telemetry audit trail (exit snapshot).
+        with open(os.path.join(ck, "telemetry.json")) as f:
+            tel = json.load(f)
+        assert tel["counters"]["preempt_signals"] >= 1
+        assert tel["counters"]["preempt_saves"] == 1
+        assert tel["counters"]["fault_preempt"] == 1
+        assert tel["gauges"]["preempt_exit_ms"] >= 0
+
+        # Restart with the SAME plan (the scale_chain shape): the firing
+        # is single-shot across processes, so the resume trains through.
+        res = run_train_cli(data, ck, **{"--fault_plan": ["preempt@step=0"]})
+        assert "resumed from step 1" in res.stderr, res.stderr[-2000:]
+        # (Metrics equality waits for the death check: a child dying of
+        # the native defect can log a silently-garbled tail value — the
+        # RESILIENCE.md "garbage scalar reads" form — which is not a
+        # resume regression.)
+        _skip_if_native_restore_death(res)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert infos(ck)["last_step"] == 4
+        # Post-resume metrics continue the twin's stream bit-exactly.
+        m, mt = train_metrics(ck), train_metrics(twin_run)
+        assert set(m) >= {2, 3, 4}
+        for s in sorted(set(m) & set(mt)):
+            assert m[s]["loss"] == mt[s]["loss"], (
+                f"step {s}: resumed loss {m[s]['loss']} != twin "
+                f"{mt[s]['loss']} — resume is not deterministic")
+
+    def test_preempt_resume_is_bit_identical_to_twin(self, data, tmp_path,
+                                                     twin_run):
+        """The acceptance drill's bit-exactness half.  preempt@step=1 is
+        honored at boundary step 2, which an epoch-boundary save just made
+        durable — so this also pins the redundant-save skip — and the
+        resume restores a best-manager checkpoint (the stable restore
+        shape in this environment, so the comparison usually completes
+        instead of skipping on the native defect)."""
+        from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+
+        ck = str(tmp_path / "preempt2")
+        proc = run_train_cli(data, ck,
+                             **{"--fault_plan": ["preempt@step=1"]})
+        assert proc.returncode == EXIT_PREEMPTED, (
+            f"rc={proc.returncode}\n{proc.stderr[-2000:]}")
+        assert "checkpoint already current" in proc.stderr
+        assert _summary_json(proc)["saved"] is False
+        with open(os.path.join(ck, "telemetry.json")) as f:
+            tel = json.load(f)
+        assert tel["counters"]["preempt_saves"] == 0
+
+        res = run_train_cli(data, ck, **{"--fault_plan": ["preempt@step=1"]})
+        assert "resumed from step 2" in res.stderr, res.stderr[-2000:]
+        _skip_if_native_restore_death(res)
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert infos(ck)["last_step"] == 4 == infos(twin_run)["last_step"]
+        m, mt = train_metrics(ck), train_metrics(twin_run)
+        assert set(m) == {1, 2, 3, 4}
+        for s in (1, 2, 3, 4):
+            assert m[s]["loss"] == mt[s]["loss"], (
+                f"step {s}: resumed loss {m[s]['loss']} != twin "
+                f"{mt[s]['loss']} — resume is not deterministic")
+        # The headline claim: final params bit-identical to the twin's.
+        _assert_params_bit_identical(tmp_path, ck, twin_run, 4)
+
+    def test_plain_sigterm_exits_cleanly_within_one_step(self, data,
+                                                         tmp_path):
+        """SIGTERM delivered EXTERNALLY to a plain train.py run (no fault
+        plan) — the spot-reclaim shape: the run must exit via the
+        checkpoint-and-exit path (rc 75, verified save, JSON summary),
+        never via a traceback.  The loader is throttled so the kill
+        reliably lands mid-run."""
+        from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+        from conftest import CACHE_DIR
+
+        ck = str(tmp_path / "sigterm")
+        driver = tmp_path / "throttled_train.py"
+        driver.write_text(
+            "import sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from cst_captioning_tpu.data import loader as loader_mod\n"
+            "_orig = loader_mod.CaptionLoader.next_batch\n"
+            "def slow(self):\n"
+            "    time.sleep(0.5)\n"
+            "    return _orig(self)\n"
+            "loader_mod.CaptionLoader.next_batch = slow\n"
+            "import train as train_cli\n"
+            "sys.exit(train_cli.main(sys.argv[1:]))\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+        proc = subprocess.Popen(
+            [sys.executable, str(driver),
+             *chaos_argv(data, ck, **{"--max_epochs": ["50"]})],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            # Wait until the run is demonstrably mid-loop (first train
+            # record durably logged), then deliver the reclaim signal.
+            metrics = os.path.join(ck, "metrics.jsonl")
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if os.path.exists(metrics) and open(metrics).read().strip():
+                    break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+            assert proc.poll() is None, "run ended before it could be killed"
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=240)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        assert proc.returncode == EXIT_PREEMPTED, (
+            f"rc={proc.returncode}\nstdout:{out[-2000:]}\nstderr:"
+            f"{err[-2000:]}")
+        assert "Traceback" not in err, err[-2000:]
+        assert "will checkpoint and exit at the next step boundary" in err
+        summary = json.loads(
+            [ln for ln in out.splitlines() if ln.strip().startswith("{")][-1])
+        assert summary["preempted"] == "SIGTERM"
+        saved_step = summary["step"]
+        if summary["saved"]:
+            assert verify_step_dir(os.path.join(
+                ck, "recovery", str(saved_step)))[0] == "verified"
+
+    def test_save_interval_secs_bounds_lost_work_by_wallclock(self, data,
+                                                              tmp_path):
+        """--save_interval_secs: with a tiny interval every non-epoch step
+        boundary produces a recovery save; with a huge one, none do (the
+        wall clock, not the step count, is what gates)."""
+        ck = str(tmp_path / "interval")
+        proc = run_train_cli(data, ck,
+                             **{"--save_interval_secs": ["0.001"]})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        # bpe 2, 4 steps: interval saves at steps 1 and 3 (recovery keeps
+        # the newest), epoch saves at 2 and 4.
+        assert verify_step_dir(os.path.join(ck, "recovery", "3"))[0] \
+            == "verified"
+        with open(os.path.join(ck, "telemetry.json")) as f:
+            assert json.load(f)["counters"]["checkpoints_saved"] == 4
+
+        ck2 = str(tmp_path / "interval_off")
+        proc = run_train_cli(data, ck2,
+                             **{"--save_interval_secs": ["3600"]})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert not os.path.isdir(os.path.join(ck2, "recovery"))
+        with open(os.path.join(ck2, "telemetry.json")) as f:
+            assert json.load(f)["counters"]["checkpoints_saved"] == 2
 
 
 # -- wedge drill (subprocess; the watchdog must exit 124) ------------------

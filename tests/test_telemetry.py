@@ -215,6 +215,30 @@ class TestMetricsRegistry:
         reg.write_snapshot(path)
         assert json.load(open(path))["counters"]["checkpoints_saved"] == 1
 
+    def test_preemption_counters_in_exit_snapshot(self, tmp_path):
+        """The preemption audit trail (ISSUE 4): counters DECLARED at 0
+        (so 'armed, nothing happened' is visible) plus the signal-to-exit
+        gauge all land in the telemetry.json exit snapshot the trainer
+        writes on the preempt path."""
+        reg = MetricsRegistry()
+        reg.declare("preempt_signals", "preempt_saves")
+        path = str(tmp_path / "telemetry.json")
+        reg.write_snapshot(path)
+        armed = json.load(open(path))
+        assert armed["counters"]["preempt_signals"] == 0
+        assert armed["counters"]["preempt_saves"] == 0
+
+        reg.inc("preempt_signals", 2)
+        reg.inc("preempt_saves")
+        reg.set_gauge("preempt_exit_ms", 812.5)
+        reg.write_snapshot(path)
+        fired = json.load(open(path))
+        assert fired["counters"]["preempt_signals"] == 2
+        assert fired["counters"]["preempt_saves"] == 1
+        assert fired["gauges"]["preempt_exit_ms"] == 812.5
+        # The watchdog heartbeat carries the counters too.
+        assert reg.heartbeat_payload()["counters"]["preempt_signals"] == 2
+
     def test_scalarwriter_sink_skips_non_scalars(self):
         class FakeWriter:
             def __init__(self):

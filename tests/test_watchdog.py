@@ -174,6 +174,44 @@ class TestHeartbeatFile:
         assert doc["counters"]["fault_firings"] == 3
         assert doc["last_train"]["step"] == 9
 
+    def test_heartbeat_write_is_atomic_and_leaves_no_tmp(self, tmp_path):
+        """heartbeat.json follows the telemetry.json snapshot discipline
+        (ISSUE 4 satellite): fsync'd tmp file + atomic rename — after any
+        number of polls the published file is complete JSON and no .tmp
+        litter remains for the harness to trip on."""
+        from cst_captioning_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.declare("preempt_signals")
+        hb = tmp_path / "hb.json"
+        wd = ProgressWatchdog(0.5, on_timeout=lambda g: None,
+                              heartbeat_path=str(hb),
+                              payload=reg.heartbeat_payload)
+        wd.start()
+        try:
+            deadline = time.time() + 10.0
+            while not hb.exists() and time.time() < deadline:
+                time.sleep(0.02)
+            doc = json.loads(hb.read_text())  # complete JSON, every time
+        finally:
+            wd.stop()
+        assert doc["counters"]["preempt_signals"] == 0
+        assert not (tmp_path / "hb.json.tmp").exists(), \
+            "tmp file must be renamed away, never left beside the heartbeat"
+        # The final stop() write is also clean.
+        json.loads(hb.read_text())
+        assert list(tmp_path.iterdir()) == [hb]
+
+    def test_wedge_exit_code_is_the_taxonomy_constant(self):
+        """watchdog.WEDGE_EXIT_CODE is a re-export of the consolidated
+        taxonomy (resilience/exitcodes.py) — the many existing importers
+        and the taxonomy can never drift apart."""
+        from cst_captioning_tpu.resilience.exitcodes import (EXIT_WEDGE,
+                                                             classify)
+
+        assert WEDGE_EXIT_CODE == EXIT_WEDGE == 124
+        assert classify(WEDGE_EXIT_CODE) == "wedge"
+
     def test_payload_errors_never_kill_monitoring(self, tmp_path):
         fired = []
         wd = ProgressWatchdog(0.2, on_timeout=lambda g: fired.append(g),

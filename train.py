@@ -19,6 +19,10 @@ import sys
 
 from cst_captioning_tpu.opts import parse_opts
 from cst_captioning_tpu.parallel.dp import distributed_init
+from cst_captioning_tpu.resilience.exitcodes import (EXIT_ADVANTAGE_ABORT,
+                                                     EXIT_PREEMPTED)
+from cst_captioning_tpu.resilience.preemption import (PreemptedExit,
+                                                      PreemptionHandler)
 from cst_captioning_tpu.training.trainer import NegativeAdvantageAbort, Trainer
 from cst_captioning_tpu.utils.platform import (configure_cli_logging,
                                                enable_compile_cache)
@@ -32,6 +36,11 @@ def main(argv=None) -> int:
     needs, unlike an in-process return value."""
     opt = parse_opts(argv)
     configure_cli_logging(opt.loglevel)
+    # Installed before the SLOW parts (backend init, Trainer construction,
+    # feature-table uploads): a scheduler preemption landing anywhere in
+    # bring-up must already find the checkpoint-and-exit handler armed
+    # instead of dying mid-init with the default disposition.
+    preemption = PreemptionHandler().install()
     enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
     # distributed_init touches the backend before the Trainer's own
     # watchdog exists; cover it with a short-lived one so a coordinator
@@ -40,7 +49,7 @@ def main(argv=None) -> int:
                           describe=lambda: "during distributed_init"):
         distributed_init(opt.coordinator_address,
                          opt.num_processes or None, opt.process_id)
-    trainer = Trainer(opt)
+    trainer = Trainer(opt, preemption=preemption)
     try:
         result = trainer.train()
     except NegativeAdvantageAbort as e:
@@ -49,7 +58,15 @@ def main(argv=None) -> int:
         # collapsing, reconfigure" (4) apart from crash (1) / wedge (124).
         print(json.dumps({"aborted": "negative_advantage_window",
                           "detail": str(e)}))
-        return 4
+        return EXIT_ADVANTAGE_ABORT
+    except PreemptedExit as e:
+        # SIGTERM/SIGINT honored at a step boundary: the state is durable
+        # (verified save, or the checkpoint already held this step), so
+        # the stage harness restarts us as progress, not as a failure.
+        print(json.dumps({"preempted": e.signal_name, "step": e.step,
+                          "saved": e.saved,
+                          "checkpoint_path": opt.checkpoint_path}))
+        return EXIT_PREEMPTED
     finally:
         trainer.close()
     summary = {
