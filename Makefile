@@ -32,7 +32,8 @@ TRAIN_COMMON = \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
 .PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo trace-demo \
-        scale_chain report collect chip_window clean
+        scale_chain report collect chip_window tune tune-fast tune-report \
+        clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -121,6 +122,25 @@ bench:
 	$(PY) bench.py \
 	  $(if $(DECODE_CHUNK),--decode_chunk $(DECODE_CHUNK),) \
 	  $(if $(OVERLAP),--overlap_depth $(OVERLAP),)
+
+# Rollout autotuner (tuning/): sweep decode_chunk/scan_unroll/overlap/
+# device_rewards/decode_kernel/batch on the CURRENT backend and persist
+# the winner as this platform's TUNED_CONFIGS.json entry, which train.py/
+# eval.py/bench.py then resolve as defaults (explicit flags always win;
+# PARITY.md "Tuned configs").  Deterministic + resumable: rerunning on an
+# unchanged tree reuses the record without re-measuring.  `tune` is the
+# full grid (slow, run it on the device you will train on); `tune-fast`
+# is the 2-point CPU smoke sweep whose API equivalent rides in tier-1
+# (tests/test_tuning.py).
+tune:
+	$(PY) scripts/tune.py
+
+tune-fast:
+	JAX_PLATFORMS=cpu $(PY) scripts/tune.py --fast \
+	  --batch_size 4 --seq_per_img 4 --seq_len 12 --vocab 500 --hidden 32
+
+tune-report:
+	$(PY) scripts/tune_report.py
 
 # -- zero-setup synthetic demo --------------------------------------------
 

@@ -67,12 +67,17 @@ def analytic_step_flops(args) -> dict:
 
 
 def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
-          hidden: int, use_bfloat16: bool, scan_unroll: int | None = None):
+          hidden: int, use_bfloat16: bool, scan_unroll: int | None = None,
+          decode_kernel: str | None = None):
     import jax
     import jax.numpy as jnp
 
     from cst_captioning_tpu.models import CaptionModel
-    from cst_captioning_tpu.opts import DEFAULT_REMAT_CELL, DEFAULT_SCAN_UNROLL
+    from cst_captioning_tpu.opts import (
+        DEFAULT_DECODE_KERNEL,
+        DEFAULT_REMAT_CELL,
+        DEFAULT_SCAN_UNROLL,
+    )
     from cst_captioning_tpu.training.state import create_train_state, make_optimizer
 
     model = CaptionModel(
@@ -81,6 +86,7 @@ def build(batch: int, seq_per_img: int, seq_len: int, vocab: int,
         dtype=jnp.bfloat16 if use_bfloat16 else jnp.float32,
         scan_unroll=(DEFAULT_SCAN_UNROLL if scan_unroll is None
                      else scan_unroll),
+        decode_kernel=decode_kernel or DEFAULT_DECODE_KERNEL,
         remat_cell=bool(DEFAULT_REMAT_CELL),
     )
     tx, _ = make_optimizer(learning_rate=2e-4, grad_clip=10.0)
@@ -147,15 +153,73 @@ def synthetic_rewarder(batch: int, seq_per_img: int, vocab_size: int,
     return rc, list(refs.keys()), scorer_kind, refs, vocab
 
 
+def resolve_axes(args) -> tuple[dict, dict, dict | None]:
+    """Resolve the five tunable rollout axes for THIS run.
+
+    -> (axes, sources, tuning_provenance): per axis the value and where it
+    came from — "flag" (explicit CLI), "record" (the platform's tuning
+    record, tuning/record.py), or "default" (the opts.py built-in).  The
+    same flag > record > built-in order ``opts.parse_opts`` applies to the
+    trainer, so bare ``python bench.py`` measures exactly the configuration
+    a bare ``python train.py`` would run.
+    """
+    from cst_captioning_tpu.opts import (
+        DEFAULT_DECODE_CHUNK,
+        DEFAULT_DECODE_KERNEL,
+        DEFAULT_DEVICE_REWARDS,
+        DEFAULT_OVERLAP_REWARDS,
+        DEFAULT_SCAN_UNROLL,
+    )
+    from cst_captioning_tpu.tuning.record import resolved_tuned_defaults
+
+    tuned, provenance = resolved_tuned_defaults()
+    builtin = {
+        "decode_chunk": DEFAULT_DECODE_CHUNK,
+        "scan_unroll": DEFAULT_SCAN_UNROLL,
+        "overlap_rewards": DEFAULT_OVERLAP_REWARDS,
+        "device_rewards": DEFAULT_DEVICE_REWARDS,
+        "decode_kernel": DEFAULT_DECODE_KERNEL,
+    }
+    argname = {"overlap_rewards": "overlap_depth"}  # bench's historical name
+    axes, sources = {}, {}
+    for axis, default in builtin.items():
+        value = getattr(args, argname.get(axis, axis), None)
+        if value is not None:
+            axes[axis], sources[axis] = value, "flag"
+        elif axis in tuned:
+            axes[axis], sources[axis] = tuned[axis], "record"
+        else:
+            axes[axis], sources[axis] = default, "default"
+    return axes, sources, provenance
+
+
+def tuning_fields(args) -> dict:
+    """The tuned-provenance JSON fields (ISSUE 6 satellite): ``tuned`` is
+    True only when at least one axis actually resolved from a tuning
+    record, and then ``tuning_record``/``tuned_axes`` say which record and
+    which values — a hand-flagged run can never be confused with a tuned
+    one."""
+    axes, sources, provenance = resolve_axes(args)
+    from_record = sorted(a for a, s in sources.items() if s == "record")
+    fields: dict = {"tuned": bool(from_record), "tuning_record": None}
+    if from_record and provenance is not None:
+        fields["tuning_record"] = provenance.get("record")
+        fields["tuned_axes"] = {a: axes[a] for a in from_record}
+        fields["tuning_git_sha_matches_head"] = provenance.get(
+            "git_sha_matches_head")
+    return fields
+
+
 def bench_xe(args):
     import jax
     import jax.numpy as jnp
 
     from cst_captioning_tpu.training.steps import make_xe_step
 
+    axes, _, _ = resolve_axes(args)
     model, state, feats, labels = build(
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
-        args.hidden, args.bfloat16,
+        args.hidden, args.bfloat16, scan_unroll=axes["scan_unroll"],
     )
     weights = jnp.ones((args.batch_size * args.seq_per_img,))
     step = jax.jit(make_xe_step(model, args.seq_per_img), donate_argnums=(0,))
@@ -227,141 +291,158 @@ def rollout_step_probe(model, state, feats, args, decode_chunk: int) -> dict:
     }
 
 
-def bench_cst(args):
-    """Full CST iteration throughput in the SHIPPED trainer configuration.
+def bench_cst(args, paths: tuple = ("host", "serial", "fused"),
+              probe: bool = True):
+    """CST iteration throughput in the SHIPPED trainer configuration.
 
     The shipped default (--device_rewards 1, opts.DEFAULT_DEVICE_REWARDS)
     fuses rollout + on-device CIDEr-D + REINFORCE grad into ONE XLA
     program; that path is the headline CST number.  The host reward path
     (C++ scorer + overlapped pipeline at the trainer's --overlap_rewards
-    default, plus the strictly serial reference-semantics loop) is always
+    default, plus the strictly serial reference-semantics loop) is
     measured and reported alongside — and becomes the headline when
     --device_rewards 0 is passed or the fused path cannot execute on this
     backend (then labeled ``cst_path: host_pipeline_fallback``).
 
-    All rollouts honor --decode_chunk (default = the trainer's shipped
-    opts.DEFAULT_DECODE_CHUNK): the early-exit chunked scan, whose
-    executed-step savings are reported by ``rollout_step_probe``.
+    Every rollout axis (--decode_chunk, --scan_unroll, --decode_kernel,
+    depth, device_rewards) resolves flag > tuning record > built-in
+    (``resolve_axes``), so bare ``python bench.py`` measures the tuned
+    shipped configuration.
+
+    ``paths`` selects which of {"host", "serial", "fused"} to measure —
+    the autotuner (tuning/sweep.py) pays for exactly one path per sweep
+    point; the full bench measures all three.  Unmeasured paths report
+    None.  ``probe=False`` skips the untimed early-exit accounting probe.
     """
     import jax
 
-    from cst_captioning_tpu.opts import (
-        DEFAULT_DECODE_CHUNK,
-        DEFAULT_DEVICE_REWARDS,
-        DEFAULT_OVERLAP_REWARDS,
-    )
-    from cst_captioning_tpu.training.pipeline import RewardPipeline
-    from cst_captioning_tpu.training.steps import (
-        make_rl_grad_step,
-        make_rollout_fused,
-    )
-
+    axes, _, _ = resolve_axes(args)
     model, state, feats, labels = build(
         args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
-        args.hidden, args.bfloat16,
+        args.hidden, args.bfloat16, scan_unroll=axes["scan_unroll"],
+        decode_kernel=axes["decode_kernel"],
     )
     rc, video_ids, scorer_kind, refs, vocab = synthetic_rewarder(
         args.batch_size, args.seq_per_img, args.vocab,
         native=bool(args.native_cider),
     )
     ncaps = args.batch_size * args.seq_per_img
-    dc = (args.decode_chunk if args.decode_chunk is not None
-          else DEFAULT_DECODE_CHUNK)
+    dc = axes["decode_chunk"]
+    depth = axes["overlap_rewards"]
+    want_fused = axes["device_rewards"]
 
-    rollout = jax.jit(make_rollout_fused(model, args.seq_len,
-                                         args.seq_per_img, decode_chunk=dc))
-    rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
-                      donate_argnums=(0,))
-    depth = (args.overlap_depth if args.overlap_depth is not None
-             else DEFAULT_OVERLAP_REWARDS)
-
-    def run_loop(state, depth, steps, key0):
-        # The EXACT shipped pipeline: bench and trainer drive the same class.
-        pipe = RewardPipeline(
-            rollout, rl_step,
-            lambda ctx, s, g: rc(ctx, s, g), depth,
+    overlapped = serial = None
+    if "host" in paths or "serial" in paths:
+        from cst_captioning_tpu.training.pipeline import RewardPipeline
+        from cst_captioning_tpu.training.steps import (
+            make_rl_grad_step,
+            make_rollout_fused,
         )
-        last = None
-        for i in range(steps):
-            key = jax.random.PRNGKey(key0 + i)
-            state, done = pipe.push(state, feats, key, key, video_ids)
+
+        rollout = jax.jit(make_rollout_fused(
+            model, args.seq_len, args.seq_per_img, decode_chunk=dc))
+        rl_step = jax.jit(make_rl_grad_step(model, args.seq_per_img),
+                          donate_argnums=(0,))
+
+        def run_loop(state, depth, steps, key0):
+            # The EXACT shipped pipeline: bench and trainer drive the same
+            # class.
+            pipe = RewardPipeline(
+                rollout, rl_step,
+                lambda ctx, s, g: rc(ctx, s, g), depth,
+            )
+            last = None
+            for i in range(steps):
+                key = jax.random.PRNGKey(key0 + i)
+                state, done = pipe.push(state, feats, key, key, video_ids)
+                if done:
+                    last = done[-1]
+            state, done = pipe.drain(state)
             if done:
                 last = done[-1]
-        state, done = pipe.drain(state)
-        if done:
-            last = done[-1]
-        float(last[1]["loss"])  # value fetch: trustworthy barrier (see bench_xe)
-        return state
+            # value fetch: trustworthy barrier (see bench_xe)
+            float(last[1]["loss"])
+            return state
 
-    state = run_loop(state, depth, 2, 0)                       # compile/warm
-    t0 = time.perf_counter()
-    state = run_loop(state, depth, args.steps, 100)
-    overlapped = ncaps * args.steps / (time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    state = run_loop(state, 0, args.steps, 200)
-    serial = ncaps * args.steps / (time.perf_counter() - t0)
+        state = run_loop(state, depth, 2, 0)                   # compile/warm
+        if "host" in paths:
+            t0 = time.perf_counter()
+            state = run_loop(state, depth, args.steps, 100)
+            overlapped = ncaps * args.steps / (time.perf_counter() - t0)
+        if "serial" in paths:
+            t0 = time.perf_counter()
+            state = run_loop(state, 0, args.steps, 200)
+            serial = ncaps * args.steps / (time.perf_counter() - t0)
 
     # Fully-fused on-device reward path (--device_rewards 1): rollout +
     # CIDEr-D + grad as ONE program, strict on-policy, zero host boundary.
     # Imports/table build run OUTSIDE the try so a code regression fails
     # loudly; only backend execution failures (compile/OOM on an exotic
     # device) degrade to fused=null without sinking the headline above.
-    from cst_captioning_tpu.training.device_rewards import build_device_tables
-    from cst_captioning_tpu.training.steps import make_fused_cst_step
-
-    corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
-    step_fn = make_fused_cst_step(model, args.seq_len, args.seq_per_img,
-                                  corpus, tables, decode_chunk=dc)
-    fused = jax.jit(step_fn, donate_argnums=(0,))
-    vix = np.arange(args.batch_size, dtype=np.int32)
-    # Trace OUTSIDE the try: a code regression in the fused step fails
-    # loudly here; only backend compile/execute failures degrade below.
-    lowered = fused.lower(state, feats, vix, jax.random.PRNGKey(300))
     fused_cps = None
-    try:
-        del lowered  # compile happens on first call
-        state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for i in range(args.steps):
-            state, m = fused(state, feats, vix, jax.random.PRNGKey(301 + i))
-        float(m["loss"])  # value fetch: trustworthy barrier (see bench_xe)
-        fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
-    except Exception as e:
-        print(f"bench: fused device-reward execution failed ({e!r}); "
-              "reporting fused=null", file=sys.stderr)
+    if "fused" in paths:
+        from cst_captioning_tpu.training.device_rewards import (
+            build_device_tables,
+        )
+        from cst_captioning_tpu.training.steps import make_fused_cst_step
 
-    want_fused = (args.device_rewards if args.device_rewards is not None
-                  else DEFAULT_DEVICE_REWARDS)
+        corpus, tables, _ = build_device_tables(refs, vocab.word_to_ix)
+        step_fn = make_fused_cst_step(model, args.seq_len, args.seq_per_img,
+                                      corpus, tables, decode_chunk=dc)
+        fused = jax.jit(step_fn, donate_argnums=(0,))
+        vix = np.arange(args.batch_size, dtype=np.int32)
+        # Trace OUTSIDE the try: a code regression in the fused step fails
+        # loudly here; only backend compile/execute failures degrade below.
+        lowered = fused.lower(state, feats, vix, jax.random.PRNGKey(300))
+        try:
+            del lowered  # compile happens on first call
+            state, m = fused(state, feats, vix, jax.random.PRNGKey(300))
+            float(m["loss"])
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                state, m = fused(state, feats, vix,
+                                 jax.random.PRNGKey(301 + i))
+            float(m["loss"])  # value fetch: trustworthy barrier (bench_xe)
+            fused_cps = ncaps * args.steps / (time.perf_counter() - t0)
+        except Exception as e:
+            print(f"bench: fused device-reward execution failed ({e!r}); "
+                  "reporting fused=null", file=sys.stderr)
+
     if want_fused and fused_cps is not None:
         value, path = fused_cps, "device_fused"
-    elif want_fused:
+    elif want_fused and overlapped is not None:
         value, path = overlapped, "host_pipeline_fallback"
         print("bench: shipped default is --device_rewards 1 but the fused "
               "path did not execute; CST headline falls back to the host "
               "pipeline (cst_path=host_pipeline_fallback)", file=sys.stderr)
+    elif want_fused:
+        value, path = None, "device_fused"  # sweep point: fused only, died
     else:
         value, path = overlapped, "host_pipeline"
     # Early-exit step accounting (untimed; see rollout_step_probe).  A
     # probe failure must not sink the measured throughput above.
-    probe = None
-    if dc > 0:
+    probe_out = None
+    if probe and dc > 0:
         try:
-            probe = rollout_step_probe(model, state, feats, args, dc)
+            probe_out = rollout_step_probe(model, state, feats, args, dc)
         except Exception as e:
             print(f"bench: rollout step probe failed ({e!r}); "
                   "reporting rollout_probe=null", file=sys.stderr)
     return {
         "value": value,
         "path": path,
-        "host_pipeline_captions_per_sec": round(overlapped, 1),
-        "serial_captions_per_sec": round(serial, 1),
+        "host_pipeline_captions_per_sec":
+            None if overlapped is None else round(overlapped, 1),
+        "serial_captions_per_sec":
+            None if serial is None else round(serial, 1),
         "fused_captions_per_sec":
             None if fused_cps is None else round(fused_cps, 1),
         "overlap_depth": depth,
         "scorer": scorer_kind,
         "decode_chunk": dc,
-        "rollout_probe": probe,
+        "scan_unroll": axes["scan_unroll"],
+        "decode_kernel": axes["decode_kernel"],
+        "rollout_probe": probe_out,
     }
 
 
@@ -392,9 +473,20 @@ def parse_args():
                    help="1 = C++ reward scorer (trainer default)")
     p.add_argument("--decode_chunk", type=int, default=None,
                    help="early-exit rollout chunk for the CST stage; "
-                        "default = the trainer's --decode_chunk default "
-                        "(read from opts.py); 0 benches the legacy "
+                        "default = the trainer's resolved default (tuning "
+                        "record, else opts.py); 0 benches the legacy "
                         "full-length scan")
+    p.add_argument("--scan_unroll", type=int, default=None,
+                   help="decoder-scan unroll for both stages; default = "
+                        "the trainer's resolved default (tuning record, "
+                        "else opts.py)")
+    p.add_argument("--decode_kernel", default=None,
+                   choices=("reference", "pallas"),
+                   help="decode-step cell for the CST rollout: the flax "
+                        "reference cell or the fused Pallas decode kernel "
+                        "(ops/pallas_decode_cell.py); default = the "
+                        "trainer's resolved default (tuning record, else "
+                        "'reference')")
     p.add_argument("--probe_eos_bias", type=float, default=10.0,
                    help="EOS-logit bias for the rollout step-count probe "
                         "(simulates a converged policy's early "
@@ -440,34 +532,29 @@ def read_cache_entry(metric: str):
 
 def resolved_config(args) -> dict:
     """The perf-affecting configuration identity of a run, with the
-    follow-the-trainer-default flags (None) normalized to their resolved
-    values so `bench.py` and `bench.py --device_rewards 1` — the same
-    measured configuration — share a cache entry.
+    follow-the-trainer-default flags (None) normalized to their RESOLVED
+    values — flag > tuning record > built-in, via ``resolve_axes`` — so
+    `bench.py` and `bench.py --device_rewards 1` (the same measured
+    configuration) share a cache entry, and a tuned-default run and the
+    same config passed as explicit flags share one too.  This identity is
+    also what the tuning record's sweep points are keyed by.
 
     "steps" is deliberately NOT part of the identity: it sets averaging
     length, not what is measured — and the CPU fallback trims it (see
     run_measurement) without forfeiting the cache attach."""
-    from cst_captioning_tpu.opts import (
-        DEFAULT_DECODE_CHUNK,
-        DEFAULT_DEVICE_REWARDS,
-        DEFAULT_OVERLAP_REWARDS,
-        DEFAULT_REMAT_CELL,
-        DEFAULT_SCAN_UNROLL,
-    )
+    from cst_captioning_tpu.opts import DEFAULT_REMAT_CELL
 
+    axes, _, _ = resolve_axes(args)
     config = {k: getattr(args, k) for k in
               ("batch_size", "seq_per_img", "seq_len", "vocab", "hidden",
-               "bfloat16", "native_cider", "overlap_depth", "device_rewards",
-               "decode_chunk")}
-    if config["overlap_depth"] is None:
-        config["overlap_depth"] = DEFAULT_OVERLAP_REWARDS
-    if config["device_rewards"] is None:
-        config["device_rewards"] = DEFAULT_DEVICE_REWARDS
-    if config["decode_chunk"] is None:
-        config["decode_chunk"] = DEFAULT_DECODE_CHUNK
-    # build() bakes these model-level defaults into the measured program,
-    # so they are part of the configuration identity too.
-    config["scan_unroll"] = DEFAULT_SCAN_UNROLL
+               "bfloat16", "native_cider")}
+    config["overlap_depth"] = axes["overlap_rewards"]
+    config["device_rewards"] = axes["device_rewards"]
+    config["decode_chunk"] = axes["decode_chunk"]
+    config["scan_unroll"] = axes["scan_unroll"]
+    config["decode_kernel"] = axes["decode_kernel"]
+    # build() bakes this model-level default into the measured program,
+    # so it is part of the configuration identity too.
     config["remat_cell"] = DEFAULT_REMAT_CELL
     return config
 
@@ -551,6 +638,11 @@ def run_measurement(args) -> None:
         # device child died) — explicit, instead of implied by "platform".
         "cpu_fallback": (platform == "cpu"
                          and os.environ.get("_BENCH_CPU_FALLBACK") == "1"),
+        # Tuned-config provenance (ISSUE 6): "tuned" says whether any axis
+        # resolved from the platform's tuning record; rides into the cache
+        # entry too, so a hand-flagged measurement can never be mistaken
+        # for a tuned one.
+        **tuning_fields(args),
     }
     # Backend-probe telemetry from the parent (attempt latencies, timeout
     # count — satellite of ISSUE 2): the parent probes, the child
@@ -604,6 +696,8 @@ def run_measurement(args) -> None:
         "cst_overlap_depth": cst["overlap_depth"],
         "cst_scorer": cst["scorer"],
         "cst_decode_chunk": cst["decode_chunk"],
+        "cst_scan_unroll": cst["scan_unroll"],
+        "cst_decode_kernel": cst["decode_kernel"],
         "cst_rollout_probe": cst["rollout_probe"],
         **{f"xe_{k}": v for k, v in xe_mfu.items()},
         **{f"cst_{k}": v for k, v in cst_mfu.items()},

@@ -1,0 +1,234 @@
+"""Pallas TPU kernel: the ENTIRE LSTM decode step as one fused kernel.
+
+``ops/pallas_attention.py`` fuses the additive-attention chain; this module
+fuses the whole autoregressive decode cell around it — attention scores ->
+softmax -> context -> gate matmuls -> LSTM state update — so one
+``pallas_call`` per decode step keeps every intermediate (the (Bb, T, A)
+tanh activation, the context vector, the 4H gate pre-activations) in VMEM.
+Unfused, XLA bounces each of those through HBM between kernels; at rollout
+shapes the per-step tensors are small enough that the HBM round trips, not
+FLOPs, dominate the step (PARITY.md rollout breakdown), which is exactly
+the regime kernel fusion pays in.
+
+Scope (deliberate):
+
+- **Decode/rollout only, forward only.**  Sampling, greedy baseline, beam
+  search and eval decode all drive ``make_decode_step``; none of them
+  differentiates (the RL grad recomputes log-probs with the teacher-forced
+  ``model.__call__`` — see ops/sampling.py module doc), so the kernel
+  carries no VJP.  Teacher-forced training keeps the existing nn.scan cell
+  (with the optional fused-attention kernel) untouched.
+- **Single-layer attention-LSTM** (the shipped architecture).  Other
+  configurations (num_layers > 1, pooled/no-attention, transformer) fall
+  back to the reference cell — ``pallas_decode_supported`` is the one
+  eligibility gate, and the fallback is logged once, not silent.
+
+Numerics: the kernel mirrors the composed pallas-attention path
+bit-for-bit — attention math in fp32 exactly as ``_attention_kernel``
+(VPU multiply+reduce, NOT an MXU dot: Mosaic lowers fp32 MXU dots through
+bf16 passes, and batch-dim dot_generals fail to lower at all — see
+ops/pallas_attention.py), context cast back to the model dtype, then the
+gate algebra in the model dtype in flax ``OptimizedLSTMCell``'s exact op
+order (h-side concat-dense + bias first, input-side concat-dense second,
+``sigmoid(h + i)`` gates in i, f, g, o order).  Interpret mode executes the
+very same jnp ops, so CPU tests pin the kernel path bit-identical to the
+composed cell (tests/test_pallas_decode_cell.py); the einsum-based plain
+XLA cell differs from both by float32 ULPs only.  On hardware the gate
+matmuls lower to the MXU in the storage dtype (bf16 models run bf16 MXU
+dots natively; fp32 pays Mosaic's multi-pass lowering — the sweepable
+flag exists precisely so the autotuner measures whether that trade wins
+per platform).
+
+Layout (pallas_guide.md: grid/BlockSpec, VMEM, MXU for the gate GEMMs):
+grid over batch blocks; per block the kernel holds the step inputs
+(x, c, h, q), the (Bb, T, A)+(Bb, T, H) attention operands, and the full
+gate weights (E+H, 4H) + (H, 4H) in VMEM — weights use a constant
+index_map so every block reads the same buffer.  The embedding gather and
+the query/vocab projections stay OUTSIDE the kernel (a gather wants XLA's
+native lowering; the projections are single dense GEMMs the MXU already
+runs at peak, and hoisting the vocab head mirrors ``DecoderCell``'s own
+design).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_attention import _block_spec, default_interpret
+
+log = logging.getLogger("cst_captioning_tpu.ops.pallas_decode_cell")
+
+_GATES = ("i", "f", "g", "o")  # flax OptimizedLSTMCell concat order
+_warned_fallback = set()
+
+
+def _decode_cell_kernel(x_ref, c_ref, h_ref, q_ref, pm_ref, mem_ref, v_ref,
+                        wi_ref, wh_ref, b_ref, c_out, h_out):
+    """One decode step for a batch block, entirely in VMEM.
+
+    Attention follows ops/pallas_attention._attention_kernel op-for-op
+    (fp32 math, VPU reductions); the LSTM follows flax
+    OptimizedLSTMCell op-for-op in the storage dtype.
+    """
+    # -- additive attention (fp32, exactly as the attention kernel) -------
+    q = q_ref[:].astype(jnp.float32)                     # (Bb, A)
+    pm = pm_ref[:].astype(jnp.float32)                   # (Bb, T, A)
+    v = v_ref[:].astype(jnp.float32)                     # (1, A)
+    tanh = jnp.tanh(pm + q[:, None, :])
+    scores = jnp.sum(tanh * v[0][None, None, :], axis=2)
+    w = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.sum(w[:, :, None] * mem_ref[:].astype(jnp.float32), axis=1)
+
+    # -- LSTM gates (storage dtype, flax OptimizedLSTMCell op order) ------
+    x = x_ref[:]                                         # (Bb, E)
+    h = h_ref[:]                                         # (Bb, H)
+    c = c_ref[:]                                         # (Bb, H)
+    inp = jnp.concatenate([x, ctx.astype(x.dtype)], axis=-1)
+    # h-side concat-dense carries the bias (flax: use_bias on the h
+    # kernels only), i-side is bias-free; gates add h-part + i-part.
+    gh = jnp.dot(h, wh_ref[:]) + b_ref[:]                # (Bb, 4H)
+    gi = jnp.dot(inp, wi_ref[:])                         # (Bb, 4H)
+    hidden = h.shape[-1]
+    parts = []
+    for k in range(4):
+        sl = slice(k * hidden, (k + 1) * hidden)
+        parts.append(gh[:, sl] + gi[:, sl])
+    i = jax.nn.sigmoid(parts[0])
+    f = jax.nn.sigmoid(parts[1])
+    g = jnp.tanh(parts[2])
+    o = jax.nn.sigmoid(parts[3])
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    c_out[:] = new_c.astype(c_out.dtype)
+    h_out[:] = new_h.astype(h_out.dtype)
+
+
+def fused_decode_cell(
+    x: jnp.ndarray,            # (B, E) embedded input token
+    c: jnp.ndarray,            # (B, H) LSTM cell state
+    h: jnp.ndarray,            # (B, H) LSTM hidden state
+    query_proj: jnp.ndarray,   # (B, A) W_q h — projected by the caller
+    proj_mem: jnp.ndarray,     # (B, T, A) W_m memory, projected once
+    memory: jnp.ndarray,       # (B, T, H)
+    score_v: jnp.ndarray,      # (A,)
+    wi: jnp.ndarray,           # (E+H, 4H) input gate kernels, i|f|g|o
+    wh: jnp.ndarray,           # (H, 4H) recurrent gate kernels, i|f|g|o
+    bias: jnp.ndarray,         # (4H,) gate biases (h-side), i|f|g|o
+    block_b: int = 8,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (new_c (B, H), new_h (B, H)): one fused decode step."""
+    b, t, a = proj_mem.shape
+    hid = memory.shape[-1]
+    bb = min(block_b, b)
+    pad = (-b) % bb
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        c = jnp.pad(c, ((0, pad), (0, 0)))
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        query_proj = jnp.pad(query_proj, ((0, pad), (0, 0)))
+        proj_mem = jnp.pad(proj_mem, ((0, pad), (0, 0), (0, 0)))
+        memory = jnp.pad(memory, ((0, pad), (0, 0), (0, 0)))
+    bp = b + pad
+    e = x.shape[-1]
+    new_c, new_h = pl.pallas_call(
+        _decode_cell_kernel,
+        grid=(bp // bb,),
+        in_specs=[
+            _block_spec((bb, e), lambda i: (i, 0)),
+            _block_spec((bb, hid), lambda i: (i, 0)),
+            _block_spec((bb, hid), lambda i: (i, 0)),
+            _block_spec((bb, a), lambda i: (i, 0)),
+            _block_spec((bb, t, a), lambda i: (i, 0, 0)),
+            _block_spec((bb, t, hid), lambda i: (i, 0, 0)),
+            _block_spec((1, a), lambda i: (0, 0)),
+            _block_spec((e + hid, 4 * hid), lambda i: (0, 0)),
+            _block_spec((hid, 4 * hid), lambda i: (0, 0)),
+            _block_spec((1, 4 * hid), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _block_spec((bb, hid), lambda i: (i, 0)),
+            _block_spec((bb, hid), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, hid), c.dtype),
+            jax.ShapeDtypeStruct((bp, hid), h.dtype),
+        ],
+        interpret=interpret,
+    )(x, c, h, query_proj, proj_mem, memory, score_v.reshape(1, -1),
+      wi, wh, bias.reshape(1, -1))
+    return new_c[:b], new_h[:b]
+
+
+def pallas_decode_supported(model) -> Tuple[bool, str]:
+    """(eligible, reason): the fused cell covers the shipped architecture —
+    single-layer attention-LSTM — and everything else must fall back to
+    the reference cell rather than silently compute something different."""
+    if getattr(model, "decoder_type", "lstm") != "lstm":
+        return False, "decoder_type != lstm"
+    if getattr(model, "num_layers", 1) != 1:
+        return False, "num_layers != 1"
+    if not getattr(model, "use_attention", True):
+        return False, "use_attention=0 (pooled context has no attention chain)"
+    return True, ""
+
+
+def warn_fallback_once(reason: str) -> None:
+    """--decode_kernel pallas on an ineligible model: log ONCE per reason
+    per process (the decode step is rebuilt every trace) and continue on
+    the reference cell — a tuned record from another config must degrade,
+    not crash."""
+    if reason not in _warned_fallback:
+        _warned_fallback.add(reason)
+        log.warning("decode_kernel=pallas unsupported here (%s); "
+                    "falling back to the reference decode cell", reason)
+
+
+def make_pallas_decode_step(model, variables, memory: jnp.ndarray,
+                            proj_mem: jnp.ndarray,
+                            block_b: int = 8) -> Callable:
+    """Build ``step(carry, token (N,)) -> (carry, logits (N, V))`` on the
+    fused kernel — the same contract as ``ops.sampling.make_decode_step``.
+
+    Reads the cell's raw parameters straight from ``variables`` (the
+    param-tree layout is part of the model's stable surface — bench's
+    ``rollout_step_probe`` already indexes it) and mirrors the flax
+    modules' dtype promotion around the kernel: embedding gather and the
+    query/vocab projections in the model compute dtype, attention fp32
+    inside the kernel, gates in the model dtype.
+    """
+    params = variables["params"]
+    cell = params["cell"]
+    dtype = getattr(model, "dtype", jnp.float32)
+    emb = cell["embed"]["embedding"].astype(dtype)
+    wq = cell["attn"]["query_proj"]["kernel"].astype(dtype)
+    score_v = cell["attn"]["score_v"]                    # fp32 by design
+    lstm = cell["lstm0"]
+    wi = jnp.concatenate([lstm[f"i{g}"]["kernel"] for g in _GATES],
+                         axis=-1).astype(dtype)
+    wh = jnp.concatenate([lstm[f"h{g}"]["kernel"] for g in _GATES],
+                         axis=-1).astype(dtype)
+    bias = jnp.concatenate([lstm[f"h{g}"]["bias"] for g in _GATES],
+                           axis=-1).astype(dtype)
+    w_logit = params["logit"]["kernel"].astype(dtype)
+    b_logit = params["logit"]["bias"].astype(dtype)
+    interpret = default_interpret()
+
+    def step(carry, token):
+        (c, h), = carry
+        x = jnp.take(emb, token, axis=0)                 # (N, E)
+        q = jnp.dot(h.astype(dtype), wq)                 # (N, A)
+        new_c, new_h = fused_decode_cell(
+            x, c, h, q, proj_mem, memory, score_v, wi, wh, bias,
+            block_b=block_b, interpret=interpret,
+        )
+        logits = jnp.dot(new_h.astype(dtype), w_logit) \
+            + jnp.reshape(b_logit, (1, -1))
+        return ((new_c, new_h),), logits
+
+    return step

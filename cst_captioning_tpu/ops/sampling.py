@@ -51,7 +51,27 @@ def make_decode_step(
 
     Returned ``step(carry, token(N,)) -> (carry, logits (N, V))`` is what
     both the samplers and the beam search drive.
+
+    ``model.decode_kernel == "pallas"`` (--decode_kernel, sweepable by the
+    autotuner) routes the step through the fused Pallas decode cell
+    (ops/pallas_decode_cell.py) — attention + LSTM state update as ONE
+    kernel, bit-identical to the composed pallas-attention cell and
+    fp32-ULP-close to this reference cell (test-pinned).  Unsupported
+    configurations (multi-layer, pooled, transformer) fall back here with
+    a one-time log line.
     """
+    if getattr(model, "decode_kernel", "reference") == "pallas":
+        from .pallas_decode_cell import (
+            make_pallas_decode_step,
+            pallas_decode_supported,
+            warn_fallback_once,
+        )
+
+        ok, reason = pallas_decode_supported(model)
+        if ok:
+            return make_pallas_decode_step(model, variables, memory,
+                                           proj_mem)
+        warn_fallback_once(reason)
 
     def step(carry, token):
         carry, logits = model.apply(

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 from typing import Optional, Sequence
 
 
@@ -113,12 +114,14 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
                         "instead of storing per-step residuals — less HBM "
                         "traffic and memory, measured faster on TPU "
                         "(PARITY.md); 0 = store residuals")
-    g.add_argument("--scan_unroll", type=int, default=DEFAULT_SCAN_UNROLL,
+    g.add_argument("--scan_unroll", type=_positive_int("--scan_unroll"),
+                   default=DEFAULT_SCAN_UNROLL,
                    help="decoder-scan unroll factor (teacher forcing + "
                         "sampling rollout): k steps per lax.scan iteration, "
                         "identical numerics, amortized per-step overhead.  "
-                        "Default = measured best on TPU (PARITY.md; "
-                        "scripts/unroll_probe.py)")
+                        "Must be >= 1.  Default = measured best on TPU "
+                        "(PARITY.md; scripts/unroll_probe.py), or the "
+                        "platform's tuning record when one exists")
 
 
 def _add_optim_args(p: argparse.ArgumentParser) -> None:
@@ -177,6 +180,13 @@ DEFAULT_DECODE_CHUNK = 8
 # any value, so this is purely a measured-throughput default.
 DEFAULT_SCAN_UNROLL = 1
 
+# Decode-step cell (--decode_kernel): the flax reference cell, or the
+# fused Pallas attention+LSTM decode kernel (ops/pallas_decode_cell.py).
+# ONE constant shared by opts and bench.resolve_axes, so bench always
+# measures the cell train.py would run (flipping the shipped default can
+# never desynchronize the two).
+DEFAULT_DECODE_KERNEL = "reference"
+
 # Decoder-cell rematerialization (--remat_cell): recompute the per-step
 # attention/LSTM cell in backward instead of storing (L,B,T,A) f32
 # residuals.  On TPU v5 lite this trades trivial recompute FLOPs for
@@ -232,6 +242,41 @@ def _add_cst_args(p: argparse.ArgumentParser) -> None:
                    help="softmax temperature for WXE weight normalization")
 
 
+def _positive_int(flag: str):
+    """argparse type: integer >= 1, rejected with a one-line usage error
+    naming the flag (the --fault_plan validator pattern)."""
+
+    def parse(text: str) -> int:
+        try:
+            value = int(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{flag} expects an integer, got {text!r}") from None
+        if value < 1:
+            raise argparse.ArgumentTypeError(
+                f"{flag} must be a positive integer (>= 1), got {value}")
+        return value
+
+    return parse
+
+
+def _nonneg_int(flag: str, zero_means: str):
+    """argparse type: integer >= 0 (0 is a documented mode, not a typo)."""
+
+    def parse(text: str) -> int:
+        try:
+            value = int(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{flag} expects an integer, got {text!r}") from None
+        if value < 0:
+            raise argparse.ArgumentTypeError(
+                f"{flag} must be >= 0 (0 = {zero_means}), got {value}")
+        return value
+
+    return parse
+
+
 def _add_decode_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("decoding")
     g.add_argument("--beam_size", type=int, default=5,
@@ -242,7 +287,10 @@ def _add_decode_args(p: argparse.ArgumentParser) -> None:
                    help="maximum decode length")
     g.add_argument("--length_norm", type=float, default=0.0,
                    help="beam score length-normalization exponent; 0 = off")
-    g.add_argument("--decode_chunk", type=int, default=DEFAULT_DECODE_CHUNK,
+    g.add_argument("--decode_chunk",
+                   type=_nonneg_int("--decode_chunk",
+                                    "legacy full-length scan"),
+                   default=DEFAULT_DECODE_CHUNK,
                    help="early-exit decode: run rollout/greedy/beam scans "
                         "as a while-loop over fused scan chunks of this "
                         "many steps, stopping once every row (every beam) "
@@ -250,6 +298,16 @@ def _add_decode_args(p: argparse.ArgumentParser) -> None:
                         "step 9 pays 16 steps, not max_length.  Output is "
                         "bit-identical to the full-length scan at any "
                         "value; 0 = legacy single full-length scan")
+    g.add_argument("--decode_kernel", default=DEFAULT_DECODE_KERNEL,
+                   choices=("reference", "pallas"),
+                   help="decode-step cell for samplers/beam/eval decode: "
+                        "'reference' = the flax cell; 'pallas' = the fused "
+                        "VMEM attention+LSTM decode kernel "
+                        "(ops/pallas_decode_cell.py; single-layer "
+                        "attention-LSTM only, other configs fall back with "
+                        "a log line).  Swept by the autotuner; the "
+                        "platform's tuning record may set it as the "
+                        "default (PARITY.md 'Tuned configs')")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
@@ -416,5 +474,85 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _explicit_flags(argv: Optional[Sequence[str]]) -> set:
+    """Which tunable axes the user set EXPLICITLY on this command line.
+
+    A second mini-parser with SUPPRESS defaults (sharing the main parser's
+    @file expansion and abbreviation rules) is the one argparse-honest way
+    to tell "came from the CLI" apart from "came from a default" — tuned
+    defaults must never override an operator's explicit choice.
+    """
+    aux = argparse.ArgumentParser(add_help=False, fromfile_prefix_chars="@")
+    for axis in ("decode_chunk", "scan_unroll", "overlap_rewards",
+                 "device_rewards", "decode_kernel"):
+        aux.add_argument(f"--{axis}", default=argparse.SUPPRESS)
+    try:
+        ns, _ = aux.parse_known_args(argv)
+    except SystemExit:  # pragma: no cover - main parse already errored
+        return set()
+    return set(vars(ns))
+
+
+def apply_tuned_defaults(ns: argparse.Namespace,
+                         argv: Optional[Sequence[str]] = None,
+                         record_path: Optional[str] = None) -> None:
+    """Resolve the platform tuning record into ``ns`` IN PLACE.
+
+    Resolution order per axis (PARITY.md "Tuned configs"):
+    explicit CLI flag > tuning record winner > built-in default.  The
+    outcome is stamped on ``ns.tuned_provenance`` (JSON-serializable — it
+    rides into checkpoint infos and the telemetry.json snapshot) so every
+    run is auditable: which axes came from the record, which record,
+    measured at which git SHA, and whether that SHA still matches HEAD.
+    A missing/disabled/incomplete record leaves ``ns`` untouched with
+    ``{"tuned": False}``.
+    """
+    if argv is None:
+        argv = sys.argv[1:]
+    from .tuning.record import resolved_tuned_defaults
+
+    tuned, provenance = resolved_tuned_defaults(path=record_path)
+    applied = {}
+    if tuned:
+        explicit = _explicit_flags(argv)
+        for axis, value in tuned.items():
+            if axis in explicit or not hasattr(ns, axis):
+                continue
+            setattr(ns, axis, value)
+            applied[axis] = value
+    if applied and provenance is not None:
+        ns.tuned_provenance = {"tuned": True, "applied": applied,
+                               **provenance}
+    else:
+        ns.tuned_provenance = {"tuned": False}
+
+
+_warned_overlap_ignored = False
+
+
+def _warn_overlap_under_device_rewards(ns: argparse.Namespace,
+                                       argv: Optional[Sequence[str]]) -> None:
+    """--overlap_rewards only exists on the host reward path; under the
+    fused --device_rewards 1 step there is no host boundary to overlap.
+    An explicitly-set value that will be ignored gets ONE stderr line
+    (not silence, not a per-step nag)."""
+    global _warned_overlap_ignored
+    if _warned_overlap_ignored:
+        return
+    if argv is None:
+        argv = sys.argv[1:]
+    if not int(getattr(ns, "device_rewards", 0)):
+        return
+    if "overlap_rewards" in _explicit_flags(argv):
+        _warned_overlap_ignored = True
+        print("warning: --overlap_rewards is ignored under "
+              "--device_rewards 1 (the fused step has no host reward "
+              "boundary to overlap); pass --device_rewards 0 to use the "
+              "host pipeline", file=sys.stderr)
+
+
 def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
-    return build_parser().parse_args(argv)
+    ns = build_parser().parse_args(argv)
+    apply_tuned_defaults(ns, argv)
+    _warn_overlap_under_device_rewards(ns, argv)
+    return ns

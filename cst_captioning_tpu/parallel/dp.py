@@ -39,6 +39,12 @@ def data_parallel_jit(
         choose (out_shardings=None) can leave updated params sharded,
         which would silently break checkpointing and later steps.
       donate_batch: also donate every ``batch_argnums`` argument.  XLA
+        (Kernel-path audit, ISSUE 6: ``--decode_kernel pallas`` changes
+        nothing here — the fused decode cell consumes the same replicated
+        params and while-loop-carried decode buffers as the reference
+        cell, allocates its working set as kernel-managed VMEM blocks,
+        and adds no donatable argument; the state-donation contract below
+        is kernel-independent, test-pinned via parallel/dryrun.py.)
         donation is input->output ALIASING, so this only frees HBM when
         the program emits a batch-shaped output the input can alias onto
         (``out_batch_tree`` steps: token transforms, in-place table

@@ -24,7 +24,8 @@ FEAT_SHAPES = [(4, 12), (1, 6)]
 
 
 def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
-                    xe_steps: int = 1) -> dict:
+                    xe_steps: int = 1,
+                    decode_kernel: str = "reference") -> dict:
     """Run XE steps, a rollout with host round-trip, and an RL grad step,
     all sharded over an ``n_devices``-wide data-parallel mesh.
 
@@ -32,6 +33,15 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
     divisible by every device count under comparison when checking 1-vs-N
     equivalence.  Returns host copies of everything a caller might assert
     on: xe_losses, sampled/greedy tokens, rl_loss, final params.
+
+    ``decode_kernel="pallas"`` routes every rollout through the fused
+    Pallas decode cell (ops/pallas_decode_cell.py) — the donation-audit
+    surface for the kernel path: the pallas step introduces NO new
+    donatable arguments (its operands are the same while-loop carries and
+    replicated params as the reference cell; per-block VMEM buffers are
+    kernel-managed), so the state-donation / donate_batch contract of
+    ``data_parallel_jit`` is identical under either kernel — pinned by
+    tests/test_pallas_decode_cell.py on this helper.
     """
     from cst_captioning_tpu.models import CaptionModel
     from cst_captioning_tpu.parallel import (
@@ -59,6 +69,7 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
     model = CaptionModel(
         vocab_size=V, embed_size=HIDDEN, hidden_size=HIDDEN,
         attn_size=HIDDEN, num_layers=1, use_attention=True, dropout_rate=0.5,
+        decode_kernel=decode_kernel,
     )
     tx, _ = make_optimizer(learning_rate=1e-3, grad_clip=5.0)
     state = create_train_state(

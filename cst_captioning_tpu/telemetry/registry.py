@@ -39,9 +39,18 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, Dict[str, float]] = {}
+        self._meta: Dict[str, Any] = {}
         self._sinks: List[Any] = []
         self._last_train: Optional[Dict[str, Any]] = None
         self._last_val: Optional[Dict[str, Any]] = None
+
+    def set_meta(self, name: str, value: Any) -> None:
+        """Run-constant provenance (JSON-serializable) stamped into every
+        snapshot under ``meta`` — e.g. the tuned-config resolution record
+        (``meta.tuned_config``).  Unlike gauges these never change per
+        step; unlike counters they carry structure."""
+        with self._lock:
+            self._meta[name] = value
 
     # -- instruments -------------------------------------------------------
 
@@ -117,6 +126,7 @@ class MetricsRegistry:
             return {
                 "schema": METRICS_SCHEMA,
                 "time": time.time(),
+                "meta": dict(self._meta),
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "histograms": hists,

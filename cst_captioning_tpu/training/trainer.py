@@ -95,6 +95,7 @@ def build_model(opt, vocab_size: int, seq_length: int) -> CaptionModel:
         tx_max_len=max(seq_length + 1, opt.max_length + 1),
         dtype=jnp.bfloat16 if opt.use_bfloat16 else jnp.float32,
         use_pallas_attention=bool(getattr(opt, "pallas_attention", 0)),
+        decode_kernel=getattr(opt, "decode_kernel", "reference"),
         fusion_type={"manet": "modality"}.get(
             getattr(opt, "fusion_type", "temporal"), "temporal"),
         scan_unroll=getattr(opt, "scan_unroll", DEFAULT_SCAN_UNROLL),
@@ -240,6 +241,13 @@ class Trainer:
         # heartbeat/exit snapshot carries them: a reader can tell "armed,
         # nothing happened" from "feature absent" (registry.declare).
         self._telemetry.registry.declare("preempt_signals", "preempt_saves")
+        # Tuned-config provenance (opts.apply_tuned_defaults) rides into
+        # the telemetry.json exit snapshot: every run answers "which axes
+        # came from which tuning record" without consulting the CLI line
+        # that launched it (PARITY.md "Tuned configs").
+        self._telemetry.registry.set_meta(
+            "tuned_config",
+            getattr(opt, "tuned_provenance", None) or {"tuned": False})
         if opt.eval_metric not in self.KNOWN_EVAL_METRICS:
             # Fail at startup, not after the first epoch's validation
             # silently scores 0.0 forever.

@@ -1,0 +1,32 @@
+"""Autotuning: persistent per-platform rollout-throughput configs.
+
+``sweep`` measures (offline, `make tune` / `make tune-fast`), ``record``
+persists and resolves — see the module docs and PARITY.md "Tuned configs"
+for the record schema and the flag > record > built-in resolution order.
+"""
+
+from .record import (
+    RECORD_ENV,
+    TUNABLE_AXES,
+    default_record_path,
+    load_record,
+    platform_entry,
+    resolve_platform,
+    resolved_tuned_defaults,
+    save_platform_entry,
+)
+from .sweep import (
+    PARITY_SHAPE_GRID,
+    base_namespace,
+    pick_winner,
+    run_sweep,
+    sweep_space,
+)
+
+__all__ = [
+    "PARITY_SHAPE_GRID", "RECORD_ENV", "TUNABLE_AXES",
+    "base_namespace", "default_record_path", "load_record",
+    "pick_winner", "platform_entry", "resolve_platform",
+    "resolved_tuned_defaults", "run_sweep", "save_platform_entry",
+    "sweep_space",
+]

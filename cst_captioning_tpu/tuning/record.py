@@ -1,0 +1,219 @@
+"""Persistent per-platform tuning records — tuned defaults, not hand-set.
+
+The rollout-throughput knobs (``--decode_chunk``, ``--scan_unroll``,
+``--overlap_rewards``, ``--device_rewards``, ``--decode_kernel``) have one
+measured best value PER PLATFORM, not per run; re-deriving them by hand for
+every deployment is how BENCH_r01-r05 spent five rounds.  Following the
+compile-once / cache-keyed discipline of arXiv 2603.09555 (PAPERS.md), the
+autotuner (``tuning/sweep.py``) discovers them once, this module persists
+them, and ``opts.py`` resolves them as defaults at startup:
+
+    explicit CLI flag  >  tuning record  >  built-in opts default
+
+Record file (``TUNED_CONFIGS.json`` at the repo root, override with the
+``CST_TUNED_CONFIGS`` env var; empty string disables resolution entirely):
+
+    {"version": 1,
+     "platforms": {
+       "<platform>": {            # jax platform string: "tpu", "cpu", ...
+         "platform": ...,
+         "device_kind": ...,      # e.g. "TPU v5 lite"
+         "git_sha": ...,          # code identity that produced the numbers
+         "measured_at": ...,
+         "sweep": {"mode": "full"|"fast", "steps": N,
+                   "base_config": {...}},   # bench-shape identity
+         "points": [{"config": {axes...}, "captions_per_sec": x,
+                     "path": "device_fused"|"host_pipeline"}, ...],
+         "winner": {axes...},     # the tuned values opts.py applies
+         "winner_captions_per_sec": x,
+         "complete": true|false}}}
+
+Writes go through ``resilience.integrity.atomic_json_write`` (fsync'd tmp +
+rename + dir fsync) and MERGE by platform key: a CPU sweep can never
+clobber the TPU entry — the invariant the ISSUE-6 satellite pins.
+
+Honesty rules baked in here rather than in callers:
+
+- ``resolve_platform`` never initializes a jax backend (opts parsing must
+  stay hang-proof when the remote-TPU tunnel is down): it reads
+  ``JAX_PLATFORMS`` first, then falls back to the record's own entries,
+  preferring a device entry over ``cpu``.
+- Every application is stamped with provenance (record path, platform,
+  git SHA, whether the SHA still matches HEAD, exactly which axes were
+  applied) so telemetry.json / bench JSON can always answer "where did
+  this config come from?".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+RECORD_VERSION = 1
+RECORD_ENV = "CST_TUNED_CONFIGS"
+RECORD_BASENAME = "TUNED_CONFIGS.json"
+
+#: The opts axes a tuning record may set (winner keys outside this set are
+#: informational — e.g. bench_batch_size — and never applied to a run).
+TUNABLE_AXES = ("decode_chunk", "scan_unroll", "overlap_rewards",
+                "device_rewards", "decode_kernel")
+
+
+def _axis_valid(axis: str, value) -> bool:
+    """The SAME constraints the CLI validators enforce (opts.py
+    _positive_int/_nonneg_int/choices) — a hand-edited or corrupt record
+    must not smuggle in a value the flag parser would reject with a
+    usage error (e.g. scan_unroll=0 crashing deep inside lax.scan)."""
+    if axis == "decode_kernel":
+        return value in ("reference", "pallas")
+    if not isinstance(value, int) or isinstance(value, bool):
+        return False
+    if axis == "scan_unroll":
+        return value >= 1
+    if axis == "device_rewards":
+        return value in (0, 1)
+    return value >= 0  # decode_chunk, overlap_rewards: 0 is a mode
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_record_path() -> Optional[str]:
+    """Resolution target: $CST_TUNED_CONFIGS if set ('' disables tuned
+    resolution and returns None), else <repo>/TUNED_CONFIGS.json."""
+    env = os.environ.get(RECORD_ENV)
+    if env is not None:
+        return env or None
+    return os.path.join(repo_root(), RECORD_BASENAME)
+
+
+def load_record(path: Optional[str] = None) -> Dict[str, Any]:
+    """The whole record document (``{"version":1,"platforms":{}}`` when the
+    file is missing/unreadable — a torn or absent record must degrade to
+    built-in defaults, never crash startup)."""
+    if path is None:
+        path = default_record_path()
+    if not path or not os.path.exists(path):
+        return {"version": RECORD_VERSION, "platforms": {}}
+    try:
+        import json
+
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc.get("platforms"), dict):
+            return {"version": RECORD_VERSION, "platforms": {}}
+        return doc
+    except (OSError, ValueError):
+        return {"version": RECORD_VERSION, "platforms": {}}
+
+
+def platform_entry(platform: str,
+                   path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    return load_record(path)["platforms"].get(platform)
+
+
+def save_platform_entry(entry: Dict[str, Any],
+                        path: Optional[str] = None) -> str:
+    """Merge ``entry`` into the record under its OWN ``entry['platform']``
+    key and atomically rewrite the file.  Other platforms' entries are
+    preserved verbatim — the only way a TPU record dies is a TPU sweep
+    replacing it."""
+    from ..resilience.integrity import atomic_json_write
+
+    platform = entry.get("platform")
+    if not platform:
+        raise ValueError("tuning entry must carry its 'platform' key")
+    if path is None:
+        path = default_record_path()
+    if not path:
+        raise ValueError(f"tuning record disabled ({RECORD_ENV}='')")
+    doc = load_record(path)
+    doc["version"] = RECORD_VERSION
+    doc["platforms"][platform] = entry
+    atomic_json_write(path, doc, indent=2, sort_keys=True)
+    return path
+
+
+def resolve_platform(path: Optional[str] = None) -> Optional[str]:
+    """Platform key for startup resolution WITHOUT touching a jax backend
+    (a downed remote-TPU tunnel blocks inside backend init — bench.py's
+    whole probe dance exists because of it; CLI parsing must never pay
+    that).  Order: JAX_PLATFORMS env (first entry), else the record's own
+    entries — a device entry wins over "cpu" (production runs on a tuned
+    machine want the device config; CPU-pinned runs in this repo always
+    set JAX_PLATFORMS=cpu, tier-1 included)."""
+    env = os.environ.get("JAX_PLATFORMS", "")
+    first = env.split(",")[0].strip().lower()
+    if first:
+        return first
+    platforms = sorted(load_record(path)["platforms"])
+    if not platforms:
+        return None
+    non_cpu = [p for p in platforms if p != "cpu"]
+    return non_cpu[0] if non_cpu else platforms[0]
+
+
+def git_sha_matches_head(entry: Dict[str, Any]) -> Optional[bool]:
+    """Whether the record was measured at the current HEAD (None when
+    either side is unknown).  A mismatch does NOT veto application — every
+    commit would otherwise orphan every record — but it is stamped into
+    the provenance so a reader can judge staleness."""
+    from ..utils.platform import git_head_sha
+
+    want = entry.get("git_sha")
+    head = git_head_sha(repo_root())
+    if not want or not head or head == "unknown":
+        return None
+    return want == head
+
+
+def resolved_tuned_defaults(
+    path: Optional[str] = None,
+    platform: Optional[str] = None,
+) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]]]:
+    """-> (tuned axis values, provenance) for startup resolution.
+
+    ``tuned`` holds only TUNABLE_AXES keys present in the platform entry's
+    winner; ``provenance`` describes where they came from (path, platform,
+    git_sha, sha-vs-HEAD match, measured_at).  ``({}, None)`` when there
+    is no applicable record — the caller keeps its built-in defaults.
+    Incomplete entries (a sweep killed mid-run) are not applied: a partial
+    winner is a provisional minimum, not a measured optimum.
+    """
+    if path is None:
+        path = default_record_path()
+    if not path:
+        return {}, None
+    if platform is None:
+        platform = resolve_platform(path)
+    if not platform:
+        return {}, None
+    entry = platform_entry(platform, path)
+    if not entry or not entry.get("complete") or "winner" not in entry:
+        return {}, None
+    winner = entry["winner"] or {}
+    tuned = {}
+    for axis in TUNABLE_AXES:
+        if axis not in winner:
+            continue
+        if _axis_valid(axis, winner[axis]):
+            tuned[axis] = winner[axis]
+        else:
+            import sys
+
+            print(f"warning: tuning record {path} ({platform}) carries an "
+                  f"invalid {axis}={winner[axis]!r}; axis ignored "
+                  "(falls back to the built-in default)", file=sys.stderr)
+    if not tuned:
+        return {}, None
+    provenance = {
+        "record": os.path.abspath(path),
+        "platform": platform,
+        "git_sha": entry.get("git_sha"),
+        "git_sha_matches_head": git_sha_matches_head(entry),
+        "measured_at": entry.get("measured_at"),
+        "winner_captions_per_sec": entry.get("winner_captions_per_sec"),
+    }
+    return tuned, provenance

@@ -1,0 +1,302 @@
+"""Offline rollout-throughput autotuner: sweep, persist, resolve.
+
+Sweeps the CST rollout config space — ``decode_chunk``, ``scan_unroll``,
+``overlap_rewards``, ``device_rewards``, the ``decode_kernel``
+reference/pallas axis, and the bench batch shape — with bench.py's own
+``bench_cst`` measurement harness (the same class/step factories the
+trainer ships, so a tuned number IS a trainer number), and persists the
+winner as a per-platform record (``tuning/record.py``) that ``opts.py``
+resolves as defaults at startup.
+
+Contracts the tests pin:
+
+- **Deterministic**: the point space and its order are pure functions of
+  (mode, base shapes); winners tie-break to the earlier point.
+- **Resumable**: every measured point is persisted immediately
+  (``complete: false``); a rerun re-measures only the missing points, and
+  a rerun over a ``complete`` record at the same git SHA + sweep identity
+  returns it without measuring anything (``make tune`` twice = one sweep).
+- **Platform-honest**: the entry is keyed by the platform that actually
+  ran (a CPU-fallback sweep writes ``platform: cpu``) and the per-platform
+  merge in ``record.save_platform_entry`` means a CPU sweep can never
+  overwrite a TPU record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .record import platform_entry, repo_root, save_platform_entry
+
+#: Device-scorer parity corners (vocab, seq_len, seq_per_img) — the shape
+#: grid the sweep's measured configs span.  tests/test_jax_ciderd.py pins
+#: ops/jax_ciderd.py against the Python oracle at every corner, so flipping
+#: --device_rewards on by default can never change rewards at a swept shape.
+PARITY_SHAPE_GRID = (
+    (60, 8, 2),      # small-vocab short captions, minimum multi-sample S
+    (60, 30, 5),     # short vocab, full MSR-VTT length, many samples
+    (500, 8, 5),
+    (500, 30, 2),
+    (2000, 12, 3),   # larger vocab, mid length
+)
+
+#: Incremented by every real measurement — the reuse/resume tests assert
+#: on it instead of guessing from timings.
+MEASUREMENTS = 0
+
+_BENCH_MOD = "cst_bench_harness"
+
+
+def load_bench() -> Any:
+    """Import bench.py (repo root) by file path under a stable alias, so
+    the tuner works no matter what the caller's sys.path looks like."""
+    mod = sys.modules.get(_BENCH_MOD)
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    path = os.path.join(repo_root(), "bench.py")
+    spec = importlib.util.spec_from_file_location(_BENCH_MOD, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_BENCH_MOD] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def base_namespace(batch_size: int = 32, seq_per_img: int = 20,
+                   seq_len: int = 30, vocab: int = 8000, hidden: int = 512,
+                   steps: int = 8, bfloat16: int = 1,
+                   native_cider: int = 1) -> argparse.Namespace:
+    """The non-swept measurement shape (bench.py's MSR-VTT geometry by
+    default) — part of the sweep identity, so records from different
+    shapes never masquerade as each other."""
+    return argparse.Namespace(
+        batch_size=batch_size, seq_per_img=seq_per_img, seq_len=seq_len,
+        vocab=vocab, hidden=hidden, steps=steps, bfloat16=bfloat16,
+        native_cider=native_cider, probe_eos_bias=10.0,
+    )
+
+
+def sweep_space(base: argparse.Namespace,
+                fast: bool = False) -> List[Dict[str, Any]]:
+    """Deterministic point list.  ``fast`` is the 2-point smoke sweep that
+    rides in tier-1 (shipped fused config + the pallas decode cell);
+    the full sweep covers the whole axis grid plus a batch-shape probe."""
+    from ..opts import (
+        DEFAULT_DECODE_CHUNK,
+        DEFAULT_OVERLAP_REWARDS,
+        DEFAULT_SCAN_UNROLL,
+    )
+
+    def point(decode_chunk, scan_unroll, device_rewards, overlap_rewards,
+              decode_kernel, batch_size=None):
+        return {
+            "decode_chunk": decode_chunk, "scan_unroll": scan_unroll,
+            "device_rewards": device_rewards,
+            "overlap_rewards": overlap_rewards,
+            "decode_kernel": decode_kernel,
+            "batch_size": base.batch_size if batch_size is None
+            else batch_size,
+        }
+
+    shipped = point(DEFAULT_DECODE_CHUNK, DEFAULT_SCAN_UNROLL, 1,
+                    DEFAULT_OVERLAP_REWARDS, "reference")
+    if fast:
+        return [shipped,
+                point(DEFAULT_DECODE_CHUNK, DEFAULT_SCAN_UNROLL, 1,
+                      DEFAULT_OVERLAP_REWARDS, "pallas")]
+    points: List[Dict[str, Any]] = []
+    # fused device-reward branch: chunk x unroll x kernel
+    for decode_chunk in (0, 4, 8, 16):
+        for scan_unroll in (1, 2):
+            for decode_kernel in ("reference", "pallas"):
+                points.append(point(decode_chunk, scan_unroll, 1,
+                                    DEFAULT_OVERLAP_REWARDS, decode_kernel))
+    # host reward branch: overlap depth matters only here
+    for overlap in (0, 2):
+        for decode_chunk in (0, DEFAULT_DECODE_CHUNK):
+            points.append(point(decode_chunk, DEFAULT_SCAN_UNROLL, 0,
+                                overlap, "reference"))
+    # batch-shape probe at the shipped fused config (informational axis:
+    # the winner records it as bench_batch_size; opts.py never applies a
+    # tuned batch size to training — see PARITY.md "Tuned configs")
+    points.append(point(DEFAULT_DECODE_CHUNK, DEFAULT_SCAN_UNROLL, 1,
+                        DEFAULT_OVERLAP_REWARDS, "reference",
+                        batch_size=base.batch_size * 2))
+    return points
+
+
+def sweep_identity(base: argparse.Namespace,
+                   fast: bool) -> Dict[str, Any]:
+    return {
+        "mode": "fast" if fast else "full",
+        "steps": base.steps,
+        "base_config": {k: getattr(base, k) for k in
+                        ("batch_size", "seq_per_img", "seq_len", "vocab",
+                         "hidden", "bfloat16", "native_cider")},
+    }
+
+
+def point_namespace(base: argparse.Namespace,
+                    cfg: Dict[str, Any]) -> argparse.Namespace:
+    ns = argparse.Namespace(**vars(base))
+    ns.batch_size = cfg["batch_size"]
+    ns.decode_chunk = cfg["decode_chunk"]
+    ns.scan_unroll = cfg["scan_unroll"]
+    ns.decode_kernel = cfg["decode_kernel"]
+    ns.device_rewards = cfg["device_rewards"]
+    ns.overlap_depth = cfg["overlap_rewards"]
+    return ns
+
+
+def measure_point(base: argparse.Namespace,
+                  cfg: Dict[str, Any]) -> Dict[str, Any]:
+    """One config point -> {"config", "captions_per_sec", "path"} via
+    bench.bench_cst, measuring ONLY the path this point selects (the full
+    three-way measurement is bench's job; a sweep pays per point)."""
+    global MEASUREMENTS
+    MEASUREMENTS += 1
+    bench = load_bench()
+    ns = point_namespace(base, cfg)
+    want = ("fused",) if cfg["device_rewards"] else ("host",)
+    out: Dict[str, Any] = {"config": dict(cfg)}
+    try:
+        res = bench.bench_cst(ns, paths=want, probe=False)
+        if cfg["device_rewards"]:
+            caps, path = res["fused_captions_per_sec"], "device_fused"
+        else:
+            caps, path = res["host_pipeline_captions_per_sec"], \
+                "host_pipeline"
+        out.update(captions_per_sec=caps, path=path,
+                   scorer=res.get("scorer"))
+        if caps is None:
+            out["error"] = "path did not execute on this backend"
+    except Exception as e:  # a broken point must not sink the sweep
+        out.update(captions_per_sec=None, path=None, error=repr(e))
+    return out
+
+
+def _point_key(cfg: Dict[str, Any]) -> Tuple:
+    return tuple(sorted(cfg.items()))
+
+
+def pick_winner(points: List[Dict[str, Any]],
+                batch_size: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Highest captions/s; ties break to the EARLIER point (deterministic
+    across reruns).  None when nothing measured successfully.
+
+    ``batch_size``: compare only points measured at this batch size.
+    Captions/s scales with batch, so the full sweep's 2x-batch probe
+    point would otherwise win on batch size alone and collapse the
+    recorded axes back to whatever config that probe happened to use —
+    the batch probe is informational, never the axis winner."""
+    best = None
+    for p in points:
+        caps = p.get("captions_per_sec")
+        if caps is None:
+            continue
+        if (batch_size is not None
+                and p.get("config", {}).get("batch_size") != batch_size):
+            continue
+        if best is None or caps > best["captions_per_sec"]:
+            best = p
+    return best
+
+
+def run_sweep(
+    base: Optional[argparse.Namespace] = None,
+    fast: bool = False,
+    record_path: Optional[str] = None,
+    force: bool = False,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Tuple[Dict[str, Any], bool]:
+    """Run (or resume, or reuse) the sweep on the CURRENT backend.
+
+    -> (platform entry, reused): ``reused=True`` means a complete record
+    for this platform + git SHA + sweep identity already existed and NO
+    measurement ran.  Partial records at the same identity resume; any
+    identity mismatch (shapes, mode, steps, code) restarts the sweep —
+    stale points must not mix into a fresh winner.
+    """
+    import jax
+
+    from ..utils.platform import git_head_sha
+
+    if base is None:
+        base = base_namespace()
+    say = progress or (lambda msg: None)
+    platform = jax.devices()[0].platform
+    device_kind = getattr(jax.devices()[0], "device_kind", "")
+    ident = sweep_identity(base, fast)
+    sha = git_head_sha(repo_root())
+    space = sweep_space(base, fast)
+
+    prior = platform_entry(platform, record_path)
+    measured: Dict[Tuple, Dict[str, Any]] = {}
+    if (prior is not None and not force and prior.get("git_sha") == sha
+            and prior.get("sweep") == ident):
+        if prior.get("complete"):
+            errors = sum(1 for p in prior.get("points", [])
+                         if p.get("captions_per_sec") is None)
+            if errors:
+                say(f"tune: note — {errors} point(s) in the reused record "
+                    "failed to measure (see tune_report); pass --force to "
+                    "re-measure them")
+            say(f"tune: reusing complete {platform} record "
+                f"({len(prior.get('points', []))} points, sha {sha[:12]})")
+            return prior, True
+        # Resume only SUCCESSFUL points: an errored point in a partial
+        # record may be a transient backend failure — re-measure it
+        # rather than baking the error into the final record.
+        measured = {_point_key(p["config"]): p
+                    for p in prior.get("points", [])
+                    if p.get("captions_per_sec") is not None}
+        say(f"tune: resuming {platform} sweep "
+            f"({len(measured)}/{len(space)} points already measured)")
+
+    def entry_doc(points, complete):
+        doc = {
+            "platform": platform, "device_kind": device_kind,
+            "git_sha": sha,
+            "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "sweep": ident, "points": points, "complete": complete,
+        }
+        # Winner selection is restricted to base-batch points: the full
+        # sweep's larger-batch probe reports more captions/s for the
+        # batch alone and must never decide the tuned axes.
+        winner = pick_winner(points, batch_size=base.batch_size)
+        if winner is not None:
+            axes = {k: winner["config"][k] for k in
+                    ("decode_chunk", "scan_unroll", "overlap_rewards",
+                     "device_rewards", "decode_kernel")}
+            axes["bench_batch_size"] = winner["config"]["batch_size"]
+            doc["winner"] = axes
+            doc["winner_captions_per_sec"] = winner["captions_per_sec"]
+            doc["winner_path"] = winner["path"]
+        return doc
+
+    points: List[Dict[str, Any]] = []
+    for i, cfg in enumerate(space):
+        key = _point_key(cfg)
+        if key in measured:
+            points.append(measured[key])
+            continue
+        say(f"tune: [{i + 1}/{len(space)}] {cfg}")
+        point = measure_point(base, cfg)
+        points.append(point)
+        caps = point.get("captions_per_sec")
+        say(f"tune:   -> {caps if caps is None else round(caps, 1)} "
+            f"captions/s ({point.get('path')})")
+        # Persist after EVERY point: a preempted sweep resumes from here.
+        save_platform_entry(entry_doc(points, complete=False), record_path)
+
+    final = entry_doc(points, complete=True)
+    save_platform_entry(final, record_path)
+    winner = final.get("winner")
+    say(f"tune: {platform} winner {winner} at "
+        f"{final.get('winner_captions_per_sec')} captions/s")
+    return final, False

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Offline rollout autotuner CLI — `make tune` / `make tune-fast`.
+
+Sweeps the rollout-throughput config space (decode_chunk, scan_unroll,
+overlap_rewards, device_rewards, decode_kernel, batch shape) on the
+CURRENT jax backend with bench.py's bench_cst harness and persists the
+winner as this platform's tuning record (TUNED_CONFIGS.json, or
+$CST_TUNED_CONFIGS), which opts.py then resolves as defaults at startup —
+explicit flags always win.
+
+Deterministic + resumable: every measured point is persisted immediately;
+rerunning on an unchanged tree (same git SHA, same sweep identity) reuses
+the complete record WITHOUT re-measuring.  --force re-measures.
+
+Prints ONE JSON summary line (the repo's artifact convention):
+  {"platform": ..., "winner": {...}, "winner_captions_per_sec": ...,
+   "points": N, "reused": bool, "record": path}
+
+Run under JAX_PLATFORMS=cpu for a CPU record (never touches a TPU entry —
+records are merged per platform); on a TPU host, run bare.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cst_captioning_tpu.tuning import base_namespace, run_sweep  # noqa: E402
+from cst_captioning_tpu.tuning.record import default_record_path  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--fast", action="store_true",
+                   help="2-point smoke sweep (shipped config + pallas "
+                        "decode cell) instead of the full axis grid")
+    p.add_argument("--steps", type=int, default=None,
+                   help="timed steps per point (default: 8 full, 3 fast)")
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--seq_per_img", type=int, default=20)
+    p.add_argument("--seq_len", type=int, default=30)
+    p.add_argument("--vocab", type=int, default=8000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--bfloat16", type=int, default=1)
+    p.add_argument("--native_cider", type=int, default=1)
+    p.add_argument("--record", default=None,
+                   help="tuning-record path (default: TUNED_CONFIGS.json "
+                        "at the repo root / $CST_TUNED_CONFIGS)")
+    p.add_argument("--force", action="store_true",
+                   help="re-measure even when a complete record exists")
+    return p.parse_args()
+
+
+def main() -> int:
+    args = parse_args()
+    record_path = args.record or default_record_path()
+    if not record_path:
+        print("tune: tuning record disabled (CST_TUNED_CONFIGS='') and no "
+              "--record given; nowhere to persist the sweep", file=sys.stderr)
+        return 2
+    steps = args.steps if args.steps is not None else (3 if args.fast else 8)
+    base = base_namespace(
+        batch_size=args.batch_size, seq_per_img=args.seq_per_img,
+        seq_len=args.seq_len, vocab=args.vocab, hidden=args.hidden,
+        steps=steps, bfloat16=args.bfloat16, native_cider=args.native_cider,
+    )
+    entry, reused = run_sweep(
+        base, fast=args.fast, record_path=record_path, force=args.force,
+        progress=lambda msg: print(msg, file=sys.stderr),
+    )
+    print(json.dumps({
+        "platform": entry["platform"],
+        "winner": entry.get("winner"),
+        "winner_captions_per_sec": entry.get("winner_captions_per_sec"),
+        "winner_path": entry.get("winner_path"),
+        "points": len(entry.get("points", [])),
+        "reused": reused,
+        "git_sha": entry.get("git_sha"),
+        "record": os.path.abspath(record_path),
+    }))
+    # A sweep in which no point measured successfully produced no winner —
+    # that is a failure, not a record.
+    return 0 if entry.get("winner") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Print the autotuner's sweep table(s) from the tuning record.
+
+One table per platform entry in TUNED_CONFIGS.json (or $CST_TUNED_CONFIGS
+/ --record): every measured point with its config axes and captions/s,
+the winner starred, plus the record's provenance line (git SHA,
+measured_at, completeness) — the human-readable face of the record that
+opts.py resolves at startup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cst_captioning_tpu.tuning import load_record  # noqa: E402
+from cst_captioning_tpu.tuning.record import default_record_path  # noqa: E402
+
+AXES = ("decode_chunk", "scan_unroll", "overlap_rewards",
+        "device_rewards", "decode_kernel", "batch_size")
+
+
+def print_entry(platform: str, entry: dict) -> None:
+    sweep = entry.get("sweep", {})
+    print(f"== {platform} ({entry.get('device_kind') or 'unknown device'}) "
+          f"— {sweep.get('mode', '?')} sweep, steps={sweep.get('steps')}")
+    print(f"   git_sha {entry.get('git_sha', '?')[:12]}  measured_at "
+          f"{entry.get('measured_at', '?')}  "
+          f"{'complete' if entry.get('complete') else 'INCOMPLETE (resumable)'}")
+    winner = entry.get("winner") or {}
+    header = " | ".join(f"{a:>15}" for a in AXES) + " | captions/s | path"
+    print("   " + header)
+    print("   " + "-" * len(header))
+    for p in entry.get("points", []):
+        cfg = p.get("config", {})
+        caps = p.get("captions_per_sec")
+        is_winner = (caps is not None
+                     and caps == entry.get("winner_captions_per_sec")
+                     and all(cfg.get(a) == winner.get(a) for a in AXES[:-1])
+                     and cfg.get("batch_size") == winner.get(
+                         "bench_batch_size"))
+        row = " | ".join(f"{str(cfg.get(a, '')):>15}" for a in AXES)
+        caps_s = "   failed " if caps is None else f"{caps:>10.1f}"
+        mark = "  *WINNER*" if is_winner else ""
+        err = f"  ({p['error']})" if p.get("error") else ""
+        print(f"   {row} | {caps_s} | {p.get('path') or '-'}{mark}{err}")
+    if winner:
+        print(f"   winner -> {winner} @ "
+              f"{entry.get('winner_captions_per_sec')} captions/s")
+    print()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--record", default=None)
+    args = ap.parse_args()
+    path = args.record or default_record_path()
+    if not path or not os.path.exists(path):
+        print(f"no tuning record at {path!r} — run `make tune` "
+              f"(or `make tune-fast`) first", file=sys.stderr)
+        return 1
+    doc = load_record(path)
+    platforms = doc.get("platforms", {})
+    if not platforms:
+        print(f"tuning record {path} holds no platform entries",
+              file=sys.stderr)
+        return 1
+    print(f"tuning record: {os.path.abspath(path)}")
+    for platform in sorted(platforms):
+        print_entry(platform, platforms[platform])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,15 @@ from cst_captioning_tpu.utils.platform import force_cpu_platform  # noqa: E402
 
 force_cpu_platform()
 
+# Hermetic tuned-config resolution: neither an operator's repo-root
+# TUNED_CONFIGS.json nor an exported CST_TUNED_CONFIGS may change the
+# defaults the suite pins (opts.py resolves tuning records at parse time
+# — PARITY.md "Tuned configs"), so this is a FORCE-assign, not a
+# setdefault.  '' disables resolution; tests that exercise it point
+# CST_TUNED_CONFIGS at their own tmp record via monkeypatch, and spawned
+# train/eval/bench children inherit this isolation from the environment.
+os.environ["CST_TUNED_CONFIGS"] = ""
+
 import jax  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu", (

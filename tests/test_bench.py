@@ -77,6 +77,14 @@ def test_default_emits_both_stages():
     # an explicitly-requested CPU run is not a fallback, and no probe ran
     assert out["cpu_fallback"] is False
     assert "probe" not in out
+    # tuned-config provenance (ISSUE 6): conftest pins CST_TUNED_CONFIGS=''
+    # so this suite run is hermetically un-tuned, and the artifact must say
+    # so explicitly — a hand-flagged run can never read as a tuned one
+    assert out["tuned"] is False
+    assert out["tuning_record"] is None
+    # the resolved rollout axes ride in the artifact
+    assert out["cst_decode_kernel"] in ("reference", "pallas")
+    assert out["cst_scan_unroll"] >= 1
 
 
 def test_mfu_fields_in_artifact():

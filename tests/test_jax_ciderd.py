@@ -13,6 +13,7 @@ from cst_captioning_tpu.data.vocab import Vocab
 from cst_captioning_tpu.metrics.ciderd import CiderD, build_corpus_df
 from cst_captioning_tpu.ops.jax_ciderd import ciderd_scores
 from cst_captioning_tpu.training.device_rewards import build_device_tables
+from cst_captioning_tpu.tuning.sweep import PARITY_SHAPE_GRID
 
 WORDS = ["a", "man", "is", "cooking", "dog", "runs", "the", "park",
          "woman", "sings", "plays", "guitar", "cat", "sleeps"]
@@ -274,6 +275,45 @@ def test_oov_reference_words_match_python_scorer():
     got = np.asarray(ciderd_scores(rows, vix, corpus, tables))
     want = py_scores(py, refs, video_ids, caps)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("vocab_size,seq_len,seq_per_img",
+                         PARITY_SHAPE_GRID)
+def test_parity_across_tuner_shape_grid(vocab_size, seq_len, seq_per_img):
+    """Device-scorer parity at every (vocab, seq_len, seq_per_img) corner
+    of the autotuner's swept shape space (tuning.sweep.PARITY_SHAPE_GRID).
+
+    --device_rewards 1 is the shipped default and the autotuner sweeps
+    shapes around it; this pin guarantees that no swept configuration can
+    move rewards off the host scorers — the acceptance criterion for
+    making the fused path the default everywhere the tuner may land."""
+    words = [f"w{i}" for i in range(1, vocab_size)]
+    w2i = {w: i + 1 for i, w in enumerate(words)}
+    rng = np.random.default_rng(vocab_size * 1000 + seq_len)
+    n_videos = 6
+    refs = {
+        f"v{v}": [
+            " ".join(rng.choice(words, int(rng.integers(3, seq_len + 1))))
+            for _ in range(3)
+        ]
+        for v in range(n_videos)
+    }
+    df, n = build_corpus_df(refs)
+    py = CiderD(df_mode="corpus", df=df, ref_len=float(n))
+    corpus, tables, video_row = build_device_tables(refs, w2i)
+    video_ids = list(refs.keys())
+    caps = [" ".join(rng.choice(words, int(rng.integers(1, seq_len + 1))))
+            for _ in range(n_videos * seq_per_img)]
+    rows = np.zeros((len(caps), seq_len), np.int32)
+    for i, c in enumerate(caps):
+        ids = [w2i[w] for w in c.split()][:seq_len]
+        rows[i, :len(ids)] = ids
+    vix = np.repeat([video_row[v] for v in video_ids],
+                    seq_per_img).astype(np.int32)
+    got = np.asarray(jax.jit(ciderd_scores, static_argnames="sigma")(
+        rows, vix, corpus, tables))
+    want = py_scores(py, refs, video_ids, caps)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
 
 
 def test_large_random_fuzz(setup):

@@ -1,0 +1,292 @@
+"""Fused Pallas decode cell (ops/pallas_decode_cell.py) vs the reference.
+
+The kernel's numeric contract (module doc): BIT-IDENTICAL to the composed
+fused-attention cell (same VPU attention formulation + flax-order LSTM
+algebra — interpret mode executes the identical op sequence), and float32-
+ULP-close to the plain einsum reference cell.  Greedy decodes, beam search,
+the chunked early-exit invariant, and the fused CST step must all hold
+under the new kernel; ineligible configs must FALL BACK, not diverge.
+
+Skips cleanly where Pallas is unavailable (the satellite requirement).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("jax.experimental.pallas",
+                    reason="Pallas unavailable in this jax build")
+
+from cst_captioning_tpu.models import CaptionModel  # noqa: E402
+from cst_captioning_tpu.ops.sampling import (  # noqa: E402
+    make_decode_step,
+    sample_captions,
+    sample_with_baseline,
+)
+
+B, T, H, E, A, V, L = 6, 4, 16, 12, 16, 30, 8
+
+
+def _models(**overrides):
+    kw = dict(vocab_size=V, embed_size=E, hidden_size=H, attn_size=A,
+              dropout_rate=0.5)
+    kw.update(overrides)
+    ref = CaptionModel(**kw)
+    composed = CaptionModel(**kw, use_pallas_attention=True)
+    fused = CaptionModel(**kw, decode_kernel="pallas")
+    return ref, composed, fused
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ref, composed, fused = _models()
+    feats = [jax.random.normal(jax.random.PRNGKey(1), (B, T, 8))]
+    variables = ref.init(jax.random.PRNGKey(0), feats,
+                         np.zeros((B, L), np.int32))
+    return ref, composed, fused, feats, variables
+
+
+def _drive(model, variables, feats, steps=5):
+    """Greedy-feed the decode step eagerly; returns stacked logits and the
+    token trajectory — the per-step surface every sampler drives."""
+    mem, pm, pooled = model.apply(variables, feats, method="encode")
+    carry = model.apply(variables, pooled, L, method="init_carry")
+    step = make_decode_step(model, variables, mem, pm, pooled)
+    tok = jnp.arange(B, dtype=jnp.int32) % (V - 1) + 1
+    logits, toks = [], []
+    for _ in range(steps):
+        carry, lg = step(carry, tok)
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        logits.append(np.asarray(lg))
+        toks.append(np.asarray(tok))
+    return np.stack(logits), np.stack(toks)
+
+
+class TestBitExactness:
+    def test_bit_identical_to_composed_fused_attention_cell(self, setup):
+        """The pin: one fused kernel == attention kernel + flax LSTM,
+        bit for bit (identical op sequence, interpret mode)."""
+        _, composed, fused, feats, variables = setup
+        lg_c, tk_c = _drive(composed, variables, feats)
+        lg_f, tk_f = _drive(fused, variables, feats)
+        np.testing.assert_array_equal(lg_f, lg_c)
+        np.testing.assert_array_equal(tk_f, tk_c)
+
+    def test_ulp_close_to_plain_reference_cell(self, setup):
+        """The einsum-based reference cell differs from the VPU
+        formulation by float32 ULPs only (same bound the fused-attention
+        kernel is pinned to in tests/test_pallas_attention.py)."""
+        ref, _, fused, feats, variables = setup
+        lg_r, _ = _drive(ref, variables, feats)
+        lg_f, _ = _drive(fused, variables, feats)
+        np.testing.assert_allclose(lg_f, lg_r, rtol=1e-5, atol=1e-6)
+
+    def test_block_size_does_not_change_results(self, setup):
+        from cst_captioning_tpu.ops.pallas_decode_cell import (
+            make_pallas_decode_step,
+        )
+
+        _, _, fused, feats, variables = setup
+        mem, pm, pooled = fused.apply(variables, feats, method="encode")
+        carry = fused.apply(variables, pooled, L, method="init_carry")
+        tok = jnp.arange(B, dtype=jnp.int32) % (V - 1) + 1
+        outs = []
+        for bb in (1, 4, 8):  # 4 pads B=6 -> 8: padding must be inert
+            step = make_pallas_decode_step(fused, variables, mem, pm,
+                                           block_b=bb)
+            _, lg = step(carry, tok)
+            outs.append(np.asarray(lg))
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(outs[0], outs[2])
+
+
+class TestSamplers:
+    def test_greedy_decode_tokens_match_reference(self, setup):
+        ref, _, fused, feats, variables = setup
+        want, _ = sample_captions(ref, variables, feats,
+                                  jax.random.PRNGKey(2), L, greedy=True)
+        got, _ = sample_captions(fused, variables, feats,
+                                 jax.random.PRNGKey(2), L, greedy=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_chunked_early_exit_bit_identical_under_pallas(self, setup):
+        """--decode_chunk's bit-identity contract must survive the kernel
+        swap: chunked pallas rollout == legacy pallas rollout."""
+        _, _, fused, feats, variables = setup
+        legacy = sample_with_baseline(fused, variables, feats,
+                                      jax.random.PRNGKey(3), L,
+                                      seq_per_img=2)
+        for chunk in (3, 8):
+            chunked = sample_with_baseline(fused, variables, feats,
+                                           jax.random.PRNGKey(3), L,
+                                           seq_per_img=2,
+                                           decode_chunk=chunk)
+            for a, b in zip(chunked, legacy):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_jit_rollout_deterministic_and_terminated(self, setup):
+        _, _, fused, feats, variables = setup
+        fn = jax.jit(lambda v, f, k: sample_captions(
+            fused, v, f, k, L, seq_per_img=2, decode_chunk=4))
+        t1, lp1 = fn(variables, feats, jax.random.PRNGKey(5))
+        t2, _ = fn(variables, feats, jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+        toks = np.asarray(t1)
+        assert toks.shape == (B * 2, L)
+        # 0-termination: nothing after the first EOS
+        for row in toks:
+            eos = np.argmax(row == 0) if (row == 0).any() else L
+            assert (row[eos:] == 0).all()
+        assert np.isfinite(np.asarray(lp1)).all()
+
+    def test_beam_search_matches_composed_cell(self, setup):
+        from cst_captioning_tpu.ops.beam import beam_search
+
+        _, composed, fused, feats, variables = setup
+        want = beam_search(composed, variables, feats, beam_size=3,
+                           max_len=L)
+        got = beam_search(fused, variables, feats, beam_size=3, max_len=L)
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFallback:
+    def test_multilayer_falls_back_to_reference(self, setup):
+        """num_layers=2 is outside the kernel's scope: --decode_kernel
+        pallas must produce EXACTLY the reference computation (fallback),
+        never a silently different one."""
+        ref2, _, fused2 = _models(num_layers=2)
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (B, T, 8))]
+        variables = ref2.init(jax.random.PRNGKey(0), feats,
+                              np.zeros((B, L), np.int32))
+        lg_r, _ = _drive(ref2, variables, feats, steps=3)
+        lg_f, _ = _drive(fused2, variables, feats, steps=3)
+        np.testing.assert_array_equal(lg_f, lg_r)
+
+    def test_pooled_model_falls_back(self):
+        ref0, _, fused0 = _models(use_attention=False)
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (B, T, 8))]
+        variables = ref0.init(jax.random.PRNGKey(0), feats,
+                              np.zeros((B, L), np.int32))
+        lg_r, _ = _drive(ref0, variables, feats, steps=3)
+        lg_f, _ = _drive(fused0, variables, feats, steps=3)
+        np.testing.assert_array_equal(lg_f, lg_r)
+
+    def test_supported_predicate(self):
+        from cst_captioning_tpu.ops.pallas_decode_cell import (
+            pallas_decode_supported,
+        )
+
+        ref, _, fused = _models()
+        assert pallas_decode_supported(fused) == (True, "")
+        ok, why = pallas_decode_supported(_models(num_layers=2)[2])
+        assert not ok and "num_layers" in why
+        ok, why = pallas_decode_supported(
+            CaptionModel(vocab_size=V, decoder_type="transformer"))
+        assert not ok and "decoder_type" in why
+
+
+class TestBF16:
+    def test_bf16_rollout_close_to_reference(self):
+        ref, _, fused = _models(dtype=jnp.bfloat16, dropout_rate=0.0)
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (B, T, 8))]
+        variables = ref.init(jax.random.PRNGKey(0), feats,
+                             np.zeros((B, L), np.int32))
+        lg_r, _ = _drive(ref, variables, feats, steps=3)
+        lg_f, _ = _drive(fused, variables, feats, steps=3)
+        assert lg_f.dtype == lg_r.dtype
+        np.testing.assert_allclose(lg_f.astype(np.float32),
+                                   lg_r.astype(np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
+class TestFusedCstStep:
+    def test_fused_step_runs_with_pallas_kernel(self):
+        """The tentpole composition: device-native rewards + pallas decode
+        cell in ONE program — the exact configuration the autotuner
+        sweeps as (device_rewards=1, decode_kernel=pallas)."""
+        from cst_captioning_tpu.training.device_rewards import (
+            build_device_tables,
+        )
+        from cst_captioning_tpu.training.state import (
+            create_train_state,
+            make_optimizer,
+        )
+        from cst_captioning_tpu.training.steps import make_fused_cst_step
+
+        words = {f"w{k}": k for k in range(1, V)}
+        refs = {f"v{i}": [f"w{1 + (i + j) % (V - 1)} w{1 + i % (V - 1)}"
+                          for j in range(3)] for i in range(4)}
+        corpus, tables, _ = build_device_tables(refs, words)
+        _, _, fused = _models()
+        tx, _ = make_optimizer(learning_rate=1e-2, grad_clip=5.0)
+        state = create_train_state(fused, jax.random.PRNGKey(0), [(T, 8)],
+                                   L, 2, tx, batch_size=4)
+        feats = [jax.random.normal(jax.random.PRNGKey(1), (4, T, 8))]
+        step = jax.jit(make_fused_cst_step(fused, L, 2, corpus, tables,
+                                           decode_chunk=4))
+        new_state, m = step(state, feats, np.arange(4, dtype=np.int32),
+                            jax.random.PRNGKey(9))
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["rollout_steps"]) <= L
+        # params actually moved
+        moved = any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                            jax.tree_util.tree_leaves(new_state.params)))
+        assert moved
+
+
+@pytest.mark.slow
+def test_dp_pipeline_completes_with_pallas_kernel():
+    """Donation audit for the kernel path (parallel/dp.py note): the DP
+    pipeline — state donation on, batch donation contract unchanged —
+    runs end to end with the fused decode cell on the mesh.
+
+    Marked ``slow`` (outside tier-1): the fresh 2-device child compiles
+    the whole pipeline cold (its XLA_FLAGS differ from the suite's, so
+    the persistent compile cache cannot help), ~30-60s this suite's
+    wall budget cannot spare — the kernel path's correctness is fully
+    pinned by the in-process tests above; this drill only re-proves the
+    donation wiring end to end.
+
+    Runs in a FRESH 2-device subprocess: in-process it is stable
+    standalone but segfaulted deep into a full tier-1 run (suite-context
+    native instability — the class of defect RESILIENCE.md documents for
+    this environment's CPU stack, same subprocess-isolation remedy as the
+    restore-path e2e stages).  A signal-death child that produced NO
+    Python traceback is that documented environment defect and skips with
+    its signature; a child that fails WITH a traceback is a real
+    kernel-path regression and fails loudly."""
+    import os
+    import subprocess
+    import sys
+
+    from cst_captioning_tpu.utils.platform import with_host_device_count
+
+    code = (
+        "import numpy as np\n"
+        "from cst_captioning_tpu.parallel.dryrun import run_dp_pipeline\n"
+        "out = run_dp_pipeline(2, batch_size=4, decode_kernel='pallas')\n"
+        "assert np.isfinite(out['xe_losses']).all()\n"
+        "assert np.isfinite(np.asarray(out['rl_loss']))\n"
+        "assert out['sampled'].shape[0] == 8\n"
+        "print('DP_PALLAS_OK')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo
+    env["XLA_FLAGS"] = with_host_device_count(env.get("XLA_FLAGS", ""), 2)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=420)
+    if proc.returncode < 0 and "Traceback" not in proc.stderr:
+        pytest.skip(
+            f"child died on signal {-proc.returncode} with no Python "
+            "traceback — the documented native-stack instability of this "
+            "environment's CPU backend (RESILIENCE.md), not a kernel-path "
+            "failure; the kernel itself is pinned by the in-process tests "
+            "above")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "DP_PALLAS_OK" in proc.stdout
