@@ -33,7 +33,7 @@ TRAIN_COMMON = \
 
 .PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo trace-demo \
         scale_chain report collect chip_window tune tune-fast tune-report \
-        clean
+        serve-demo serve-bench clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -141,6 +141,33 @@ tune-fast:
 
 tune-report:
 	$(PY) scripts/tune_report.py
+
+# -- caption serving (SERVING.md) -----------------------------------------
+
+# Zero-setup serving demo: pipe a few JSONL requests through the
+# continuous-batching engine (tiny untrained EOS-biased model — captions
+# are gibberish, the scheduling/backpressure/drain path is the real one).
+serve-demo:
+	printf '%s\n' \
+	  '{"id": 1, "video_id": "v0"}' \
+	  '{"id": 2, "video_id": "v1"}' \
+	  '{"id": 3, "video_id": "v2"}' \
+	  '{"id": 4, "video_id": "nope"}' \
+	  '{"id": 5, "video_id": "v3"}' \
+	| JAX_PLATFORMS=cpu $(PY) scripts/serve.py --serve_demo 1 --beam_size 1
+
+# Serving load drills + the Poisson probe: the slow socket/SIGTERM-drain
+# subprocess tests that tier-1 skips, then `bench.py --stage serving`
+# (p50/p99 latency + captions/s, 0 recompiles after warmup asserted) at
+# CPU-sized shapes, summarized as a latency table.  On a healthy device
+# window run `python bench.py --stage serving` bare for the full-shape
+# cached number.
+serve-bench:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --stage serving --platform cpu --cache 0 \
+	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
+	  --serve_requests 12 --serve_rate 6 > /tmp/cst_serve_bench.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_bench.json
 
 # -- zero-setup synthetic demo --------------------------------------------
 

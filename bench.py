@@ -446,12 +446,49 @@ def bench_cst(args, paths: tuple = ("host", "serial", "fused"),
     }
 
 
+def bench_serving(args) -> dict:
+    """Caption-serving probe (--stage serving): seeded open-loop Poisson
+    arrivals through the continuous-batching engine at this run's bench
+    shapes, EOS-biased like ``rollout_step_probe`` so the untrained bench
+    model terminates captions the way a converged policy does.  Reports
+    p50/p99 request latency + captions/s and ASSERTS 0 program builds
+    after warmup (serving/bench.py) — the compile-discipline contract."""
+    from cst_captioning_tpu.serving.bench import serving_probe
+    from cst_captioning_tpu.serving.buckets import parse_buckets
+
+    axes, _, _ = resolve_axes(args)
+    model, state, _, _ = build(
+        args.batch_size, args.seq_per_img, args.seq_len, args.vocab,
+        args.hidden, args.bfloat16, scan_unroll=axes["scan_unroll"],
+        decode_kernel=axes["decode_kernel"],
+    )
+    params = {**state.params}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = (
+        params["logit"]["bias"].at[0].add(args.probe_eos_bias))
+    out = serving_probe(
+        model, {"params": params}, [(28, 2048), (1, 4096)],
+        num_requests=args.serve_requests, rate_hz=args.serve_rate,
+        max_len=args.seq_len, beam_size=args.serve_beam,
+        decode_chunk=axes["decode_chunk"],
+        bucket_sizes=parse_buckets(args.serve_buckets),
+        queue_limit=0, seed=777,
+    )
+    out["eos_bias"] = args.probe_eos_bias
+    return out
+
+
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--stage", default="both", choices=("both", "xe", "cst"),
+    p.add_argument("--stage", default="both",
+                   choices=("both", "xe", "cst", "serving"),
                    help="'both' (default) measures XE and CST and reports "
                         "the MIN as the headline value — the driver artifact "
-                        "cannot pass on the easy stage alone")
+                        "cannot pass on the easy stage alone.  'serving' "
+                        "runs the open-loop Poisson caption-serving probe "
+                        "instead (serving/bench.py: p50/p99 request latency "
+                        "+ captions/s through the continuous-batching "
+                        "engine, 0 recompiles after warmup asserted)")
     p.add_argument("--batch_size", type=int, default=32)
     p.add_argument("--seq_per_img", type=int, default=20)
     p.add_argument("--seq_len", type=int, default=30)
@@ -487,6 +524,17 @@ def parse_args():
                         "(ops/pallas_decode_cell.py); default = the "
                         "trainer's resolved default (tuning record, else "
                         "'reference')")
+    p.add_argument("--serve_requests", type=int, default=24,
+                   help="--stage serving: requests in the seeded Poisson "
+                        "stream")
+    p.add_argument("--serve_rate", type=float, default=8.0,
+                   help="--stage serving: open-loop arrival rate (req/s)")
+    p.add_argument("--serve_buckets", default="1,4,8",
+                   help="--stage serving: batch-shape bucket ladder "
+                        "(SERVING.md 'Bucket policy')")
+    p.add_argument("--serve_beam", type=int, default=1,
+                   help="--stage serving: beam width per request (1 = "
+                        "greedy)")
     p.add_argument("--probe_eos_bias", type=float, default=10.0,
                    help="EOS-logit bias for the rollout step-count probe "
                         "(simulates a converged policy's early "
@@ -556,6 +604,13 @@ def resolved_config(args) -> dict:
     # build() bakes this model-level default into the measured program,
     # so it is part of the configuration identity too.
     config["remat_cell"] = DEFAULT_REMAT_CELL
+    if getattr(args, "stage", None) == "serving":
+        # Serving-probe identity axes (its cache entry lives under its own
+        # metric key; training-stage entries keep their historical shape).
+        config["serve_requests"] = args.serve_requests
+        config["serve_rate"] = args.serve_rate
+        config["serve_buckets"] = args.serve_buckets
+        config["serve_beam"] = args.serve_beam
     return config
 
 
@@ -653,6 +708,20 @@ def run_measurement(args) -> None:
             common["probe"] = json.loads(probe_json)
         except ValueError:
             pass
+    if args.stage == "serving":
+        serve = bench_serving(args)
+        _emit({
+            "metric": HEADLINE_METRIC["serving"],
+            "value": serve["captions_per_sec"],
+            # The 5000 caps/s north-star is a TRAINING-throughput target;
+            # an open-loop probe is capped by its arrival rate, so a ratio
+            # against it would read as a fake catastrophic regression.
+            # Serving has no baseline yet: null, honestly.
+            "vs_baseline": None,
+            **common,
+            **{k: v for k, v in serve.items() if k != "captions_per_sec"},
+        }, args)
+        return
     if args.stage == "xe":
         xe = bench_xe(args)
         _emit({
@@ -820,6 +889,7 @@ HEADLINE_METRIC = {
     "xe": "xe_captions_per_sec_per_chip",
     "cst": "cst_captions_per_sec_per_chip",
     "both": "min_xe_cst_captions_per_sec_per_chip",
+    "serving": "serve_captions_per_sec_per_chip",
 }
 
 

@@ -27,7 +27,7 @@ from typing import Callable, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .sampling import make_decode_step
+from .sampling import all_finished, make_decode_step
 
 NEG_INF = -1e9
 
@@ -146,7 +146,10 @@ def beam_search_tokens(
 
         def chunk_cond(loop):
             t, state, _, _ = loop
-            return (t < max_len) & ~jnp.all(state[3])
+            # all_finished reduces the (B, k) per-beam buffer per item
+            # first (ops/sampling.py finished_mask) — same predicate the
+            # serving engine's slot recycler reads per row.
+            return (t < max_len) & ~all_finished(state[3])
 
         # Skipped steps pre-filled with the all-finished step's provable
         # output: token 0, parent identity (docstring above).

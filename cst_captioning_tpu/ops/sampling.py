@@ -40,6 +40,28 @@ def repeat_for_captions(x: jnp.ndarray, seq_per_img: int) -> jnp.ndarray:
     return jnp.repeat(x, seq_per_img, axis=0)
 
 
+def finished_mask(finished: jnp.ndarray) -> jnp.ndarray:
+    """Per-ITEM finished predicate from a decode loop's finished buffer.
+
+    The samplers carry a per-row ``(N,)`` bool; beam search carries a
+    per-beam ``(B, k)`` bool where an item (video) is finished only once
+    EVERY beam has emitted EOS.  One helper owns that reduction so the
+    early-exit chunk predicate (here and in ``ops/beam.py``) and the
+    serving engine's slot recycler (``serving/engine.py``, which frees a
+    slot the moment its item's mask goes True) can never disagree on what
+    "finished" means.
+    """
+    if finished.ndim <= 1:
+        return finished
+    return jnp.all(finished, axis=-1)
+
+
+def all_finished(finished: jnp.ndarray) -> jnp.ndarray:
+    """Scalar: every item finished — the chunked while_loop's early-exit
+    predicate (shared by sampler and beam fast paths)."""
+    return jnp.all(finished_mask(finished))
+
+
 def make_decode_step(
     model,
     variables,
@@ -178,7 +200,7 @@ def sample_tokens(
 
     def chunk_cond(loop):
         t, state, _, _ = loop
-        return (t < max_len) & ~jnp.all(state[2])
+        return (t < max_len) & ~all_finished(state[2])
 
     # Output buffers must match the legacy scan's stacked dtypes exactly
     # (bf16 models emit bf16 logprobs) — derive them without running.

@@ -310,6 +310,67 @@ def _add_decode_args(p: argparse.ArgumentParser) -> None:
                         "default (PARITY.md 'Tuned configs')")
 
 
+def _validated_buckets(text: str) -> str:
+    """argparse type for ``--serve_buckets``: grammar errors become a
+    one-line usage error (the --fault_plan validator pattern).  The
+    validated TEXT is returned; the engine re-parses it."""
+    from .serving.buckets import parse_buckets
+
+    try:
+        parse_buckets(text)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from None
+    return text
+
+
+def _add_serving_args(p: argparse.ArgumentParser) -> None:
+    # Env fallbacks (CST_SERVE_*) resolve as argparse defaults so an
+    # operator can pin a fleet-wide bucket ladder without editing every
+    # launch line; tier-1 conftest force-clears them for hermeticity
+    # (same discipline as CST_TUNED_CONFIGS).
+    g = p.add_argument_group("serving")
+    g.add_argument("--engine", default="legacy",
+                   choices=("legacy", "serving"),
+                   help="decode engine for eval.py: 'serving' routes the "
+                        "test-split decode through the continuous-batching "
+                        "engine (serving/engine.py) at batch-offline load "
+                        "and asserts caption-for-caption equality with the "
+                        "legacy compiled decode — the end-to-end parity "
+                        "drill (SERVING.md)")
+    g.add_argument("--serve_buckets", type=_validated_buckets,
+                   default=os.environ.get("CST_SERVE_BUCKETS") or "1,4,8",
+                   help="comma-separated batch-shape bucket ladder for the "
+                        "serving engine, e.g. '1,4,8': programs compile "
+                        "once per bucket, the engine grows to the smallest "
+                        "bucket that fits demand and never compiles under "
+                        "steady load (SERVING.md 'Bucket policy').  Env "
+                        "fallback: CST_SERVE_BUCKETS")
+    # String env default + argparse `type` = the PR-4 env discipline: a
+    # malformed CST_SERVE_QUEUE_LIMIT gets the same one-line usage error
+    # as a malformed flag (argparse runs `type` on string defaults),
+    # never a parser-build traceback in CLIs that don't even serve.
+    g.add_argument("--serve_queue_limit",
+                   type=_nonneg_int(
+                       "--serve_queue_limit (or CST_SERVE_QUEUE_LIMIT)",
+                       "unbounded queue"),
+                   default=os.environ.get("CST_SERVE_QUEUE_LIMIT") or 64,
+                   help="bounded admission queue: submits beyond this "
+                        "depth are SHED with an explicit reject response "
+                        "(backpressure, never silent latency).  0 = "
+                        "unbounded (offline/parity mode).  Env fallback: "
+                        "CST_SERVE_QUEUE_LIMIT")
+    g.add_argument("--serve_port", type=int, default=0,
+                   help="scripts/serve.py front end: 0 (default) serves "
+                        "JSONL on stdin/stdout; N > 0 listens on "
+                        "127.0.0.1:N; -1 binds an ephemeral port "
+                        "(announced on stderr)")
+    g.add_argument("--serve_demo", type=int, default=0,
+                   help="scripts/serve.py: 1 = zero-setup demo backend "
+                        "(tiny untrained EOS-biased model + synthetic "
+                        "feature table; captions are gibberish, the "
+                        "serving path is real)")
+
+
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("bookkeeping")
     g.add_argument("--checkpoint_path", default="checkpoints/run",
@@ -468,6 +529,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_optim_args(p)
     _add_cst_args(p)
     _add_decode_args(p)
+    _add_serving_args(p)
     _add_bookkeeping_args(p)
     _add_resilience_args(p)
     _add_tpu_args(p)
@@ -551,8 +613,32 @@ def _warn_overlap_under_device_rewards(ns: argparse.Namespace,
               "host pipeline", file=sys.stderr)
 
 
+_warned_serving_chunk = False
+
+
+def warn_serving_decode_chunk(ns: argparse.Namespace) -> None:
+    """--decode_chunk 0 (legacy full-length scan) combined with the
+    serving engine: slot recycling needs the chunked while_loop path —
+    with chunk 0 a slot only frees at a full max_length boundary, so one
+    long caption holds every co-resident slot hostage.  ONE stderr line
+    (argparse-usage style), not silence and not a per-request nag; the
+    engine still runs, treating the rollout as a single max_length chunk."""
+    global _warned_serving_chunk
+    if _warned_serving_chunk:
+        return
+    if int(getattr(ns, "decode_chunk", 0)) == 0:
+        _warned_serving_chunk = True
+        print("warning: --decode_chunk 0 (legacy full-length scan) with "
+              "the serving engine disables mid-flight slot recycling — "
+              "slots only free every --max_length steps; pass a chunked "
+              "--decode_chunk (e.g. 8) for continuous batching",
+              file=sys.stderr)
+
+
 def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     ns = build_parser().parse_args(argv)
     apply_tuned_defaults(ns, argv)
     _warn_overlap_under_device_rewards(ns, argv)
+    if getattr(ns, "engine", "legacy") == "serving":
+        warn_serving_decode_chunk(ns)
     return ns

@@ -25,7 +25,8 @@ FEAT_SHAPES = [(4, 12), (1, 6)]
 
 def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
                     xe_steps: int = 1,
-                    decode_kernel: str = "reference") -> dict:
+                    decode_kernel: str = "reference",
+                    _attempt: int = 0) -> dict:
     """Run XE steps, a rollout with host round-trip, and an RL grad step,
     all sharded over an ``n_devices``-wide data-parallel mesh.
 
@@ -174,6 +175,26 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
         "fused_reward": fused_metrics["reward"],
         "sp_ctx_sum": (jnp.zeros(()) if sp_ctx_sum is None else sp_ctx_sum),
     })
+    # This session's native stack occasionally garbles one pipeline
+    # invocation's device scalars to 0.0 (the RESILIENCE.md caveat;
+    # observed ~1-in-3 per invocation some days, and NOT sticky — an
+    # adjacent invocation in the same process is fine).  A random-init
+    # model's XE loss is never exactly 0.0, so an all-zero loss curve is
+    # a reliable garble signature.  Fresh re-fetches of re-stacked arrays
+    # still read 0.0 (the zeros are device-side), so the recovery is a
+    # bounded DETERMINISTIC re-run of the whole pipeline: every input is
+    # seeded, so a clean retry returns exactly what a clean first attempt
+    # would have — a real, reproducible zero-loss regression would fail
+    # all retries and still surface.
+    if all(float(v) == 0.0 for v in np.asarray(scalars["xe_losses"])):
+        if _attempt < 2:
+            print(f"run_dp_pipeline: device scalars garbled to all-0.0 "
+                  f"(native-stack caveat, RESILIENCE.md); deterministic "
+                  f"re-run {_attempt + 1}/2", flush=True)
+            return run_dp_pipeline(n_devices, batch_size, xe_steps,
+                                   decode_kernel, _attempt=_attempt + 1)
+        print("run_dp_pipeline: all-0.0 scalars persisted across retries "
+              "— reporting as computed", flush=True)
     return {
         "mesh_shape": dict(mesh.shape),
         "xe_losses": [float(v) for v in scalars["xe_losses"]],

@@ -1,0 +1,42 @@
+"""Caption-serving engine: continuous batching over the compiled decode path.
+
+The training side of this repo rolls out captions in large fixed-shape
+batches; serving traffic arrives one video at a time.  This package closes
+that gap without ever recompiling per request (the cache/compile
+discipline of PAPERS.md arXiv 2603.09555):
+
+- ``buckets.py``  — a small FIXED set of batch-shape buckets with a
+  compile-once program cache and an explicit recompile counter (0 under
+  steady load, by contract);
+- ``engine.py``   — the step-driven scheduler: bucketed batch slots,
+  one-encoder-pass admission that writes encoder outputs + decoder carry
+  into the slot in place, a per-row finished predicate
+  (``ops.sampling.finished_mask``) that frees a slot mid-flight, and
+  bit-identical captions vs the offline ``eval.py`` decode (test-pinned);
+- ``server.py``   — stdin/JSONL + optional localhost-socket front end with
+  bounded-queue backpressure and graceful SIGTERM drain through the
+  ``resilience`` preemption/exit-code taxonomy;
+- ``bench.py``    — the open-loop Poisson serving probe (seeded,
+  deterministic arrivals; p50/p99 latency + captions/s) that joins the
+  repo bench's JSON line and cache.
+
+Architecture, bucket policy, and the drain contract: SERVING.md.
+"""
+
+from .buckets import DEFAULT_BUCKETS, ProgramCache, parse_buckets  # noqa: F401
+
+# Engine/server exports are lazy (PEP 562): buckets.py is pure host code,
+# but engine.py imports jax — and opts.py validates --serve_buckets at
+# parse time, which must not drag a jax init into every CLI parse.
+_LAZY = {"Completion": ".engine", "Request": ".engine",
+         "ServingEngine": ".engine", "serve_decode_split": ".engine",
+         "CaptionServer": ".server", "serving_probe": ".bench"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(_LAZY[name], __name__)
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

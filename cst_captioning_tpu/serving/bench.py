@@ -1,0 +1,117 @@
+"""Open-loop Poisson serving probe: p50/p99 latency + captions/s.
+
+Open-loop means arrivals come from a PREDETERMINED schedule (seeded
+exponential inter-arrival draws), never gated on completions — the honest
+load model for "millions of users" traffic, where a slow server doesn't
+slow the users down, it grows its own queue.  Latency is measured from
+the SCHEDULED arrival, so queueing delay is part of the number.
+
+The probe also enforces the compile discipline: ``engine.warm()`` pays
+for every bucket's programs up front, and any program build after that
+raises — steady-state serving must read 0 recompiles (the acceptance
+contract; ``buckets.ProgramCache`` is the counter).
+
+Determinism: the arrival schedule and per-request features are seeded,
+so two runs issue the identical request stream; the measured latencies
+are wall-clock (that is the point).  The repo bench (`bench.py --stage
+serving`) feeds this into its one-JSON-line + cache machinery.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from .buckets import DEFAULT_BUCKETS
+from .engine import ServingEngine
+
+
+def poisson_arrivals(num_requests: int, rate_hz: float,
+                     seed: int = 0) -> np.ndarray:
+    """Cumulative arrival times (seconds) for an open-loop Poisson stream."""
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / float(rate_hz),
+                                     size=int(num_requests)))
+
+
+def serving_probe(model, variables, feat_shapes: Sequence,
+                  *, num_requests: int = 24, rate_hz: float = 8.0,
+                  max_len: int = 30, beam_size: int = 1,
+                  length_norm: float = 0.0, decode_chunk: int = 8,
+                  bucket_sizes: Sequence[int] = DEFAULT_BUCKETS,
+                  queue_limit: int = 0, seed: int = 0,
+                  registry=None, tracer=None,
+                  clock=time.perf_counter) -> Dict[str, Any]:
+    """Drive one engine through a seeded Poisson load; -> metrics dict.
+
+    Raises ``RuntimeError`` if any program compiles after warmup — the
+    0-recompiles-under-steady-load assert, in the probe itself so a
+    regression fails the bench rather than shipping a latency cliff.
+    """
+    n = int(num_requests)
+    arrivals = poisson_arrivals(n, rate_hz, seed)
+    feat_rng = np.random.default_rng(seed + 1)
+    feats = [
+        [feat_rng.standard_normal(s).astype(np.float32)
+         for s in feat_shapes]
+        for _ in range(n)
+    ]
+    engine = ServingEngine(
+        model, variables, feat_shapes, max_len=max_len,
+        beam_size=beam_size, length_norm=length_norm,
+        decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
+        queue_limit=queue_limit, registry=registry, tracer=tracer,
+        clock=clock)
+    warm_builds = engine.warm()["compiles"]
+
+    t0 = clock()
+    submitted = 0
+    latencies: Dict[Any, float] = {}
+    shed = 0
+    while len(latencies) + shed < n:
+        now = clock() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            if not engine.submit(submitted, feats[submitted]):
+                shed += 1
+            submitted += 1
+        for comp in engine.step():
+            # Latency from the SCHEDULED arrival (open-loop convention).
+            latencies[comp.request_id] = (
+                (comp.done_at - t0) - arrivals[comp.request_id])
+        if engine.idle and submitted < n:
+            time.sleep(min(max(arrivals[submitted] - (clock() - t0), 0.0),
+                           0.01))
+    makespan = clock() - t0
+
+    stats = engine.stats()
+    recompiles = stats["compiles"] - warm_builds
+    if recompiles != 0:
+        raise RuntimeError(
+            f"serving recompiled under steady load: {recompiles} program "
+            f"build(s) after warmup (bucket discipline violated — "
+            "SERVING.md 'Bucket policy')")
+    lat_ms = np.asarray(sorted(latencies.values())) * 1e3
+    pct = (lambda q: round(float(np.percentile(lat_ms, q)), 3)
+           if lat_ms.size else None)
+    return {
+        "captions_per_sec": round(len(latencies) / makespan, 2),
+        "latency_p50_ms": pct(50),
+        "latency_p99_ms": pct(99),
+        "latency_mean_ms": (round(float(lat_ms.mean()), 3)
+                            if lat_ms.size else None),
+        "num_requests": n,
+        "completed": len(latencies),
+        "shed": shed,
+        "rate_hz": float(rate_hz),
+        "arrival_seed": int(seed),
+        "makespan_s": round(makespan, 3),
+        "recompiles_after_warmup": recompiles,
+        "program_builds_warm": warm_builds,
+        "buckets": list(engine.buckets),
+        "slots": stats["slots"],
+        "beam_size": engine.beam_size,
+        "decode_chunk": engine.chunk,
+        "max_len": int(max_len),
+    }

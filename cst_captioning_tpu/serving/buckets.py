@@ -1,0 +1,116 @@
+"""Batch-shape buckets + compile-once program cache for the serving engine.
+
+XLA programs are shape-specialized, so a serving engine that sized its
+batch to the instantaneous load would recompile on every queue-depth
+change — the exact failure mode the compiler-first caching discipline
+(PAPERS.md arXiv 2603.09555) exists to rule out.  Instead the engine runs
+at one of a SMALL FIXED set of slot counts (the buckets), and every
+compiled program is cached by a configuration-identity key built the same
+way as bench's cache-config identity (``bench.resolved_config``): the
+perf-affecting axes (bucket, beam, max_len, decode_chunk, decode_kernel,
+scan_unroll, feature geometry, dtype), nothing request-dependent.
+
+The cache keeps an explicit *builds* counter.  After ``warm()`` has paid
+for every bucket's programs, steady-state load MUST read 0 new builds —
+the serving bench probe asserts exactly that, and the counter is exported
+through the metrics registry (``serve_compiles``) so a recompile storm in
+production is a visible counter, not a silent latency cliff.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+#: The shipped bucket ladder: smallest-sufficient bucket per load level,
+#: grow-only under pressure (SERVING.md "Bucket policy").
+DEFAULT_BUCKETS = (1, 4, 8)
+
+
+def parse_buckets(spec) -> Tuple[int, ...]:
+    """``"1,4,8"`` (or an int sequence) -> sorted unique positive tuple.
+
+    Raises ``ValueError`` with a one-line message naming the bad token —
+    surfaced by opts.py as an argparse usage error.
+    """
+    if isinstance(spec, str):
+        tokens = [t for t in spec.replace(" ", "").split(",") if t]
+    else:
+        tokens = list(spec)
+    if not tokens:
+        raise ValueError("bucket spec is empty; expected e.g. '1,4,8'")
+    sizes = []
+    for tok in tokens:
+        try:
+            n = int(tok)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad bucket size {tok!r}; expected positive integers "
+                "like '1,4,8'") from None
+        if n < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {n}")
+        sizes.append(n)
+    return tuple(sorted(set(sizes)))
+
+
+def pick_bucket(buckets: Tuple[int, ...], needed: int) -> int:
+    """Smallest bucket that fits ``needed`` slots; the largest bucket when
+    demand exceeds every bucket (excess waits in the queue)."""
+    for b in buckets:
+        if b >= needed:
+            return b
+    return buckets[-1]
+
+
+class ProgramCache:
+    """Compile-once cache for the engine's jitted programs.
+
+    ``get(key, build)`` returns the cached callable or builds it exactly
+    once, bumping ``builds`` (and the ``serve_compiles`` registry counter
+    when a registry is attached).  Keys must carry the full configuration
+    identity — two configs that could compile differently must never share
+    a key.  Thread-safe: the server's front-end threads only enqueue, but
+    a warm() racing a first request must not double-build.
+    """
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._programs: Dict[tuple, Callable] = {}
+        self._registry = registry
+        self.builds = 0
+
+    def get(self, key: tuple, build: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._programs.get(key)
+            if fn is not None:
+                return fn
+        # Build OUTSIDE the lock (jit closure construction may be slow);
+        # a racing builder for the same key loses and its result is
+        # dropped without counting.
+        fn = build()
+        with self._lock:
+            won = self._programs.setdefault(key, fn)
+            if won is fn:
+                self.builds += 1
+                if self._registry is not None:
+                    self._registry.inc("serve_compiles")
+            return won
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+
+def config_key(*, bucket: int, beam_size: int, max_len: int,
+               decode_chunk: int, length_norm: float, decode_kernel: str,
+               scan_unroll: int, feat_shapes, dtype: str,
+               kind: Optional[str] = None) -> tuple:
+    """One canonical identity tuple for the program cache — the serving
+    twin of bench's ``resolved_config`` (same axes, same spirit: a tuned
+    run and its explicit-flag twin share an entry; different shapes never
+    do)."""
+    return (
+        kind, int(bucket), int(beam_size), int(max_len), int(decode_chunk),
+        float(length_norm), str(decode_kernel), int(scan_unroll),
+        tuple(tuple(int(x) for x in s) for s in feat_shapes), str(dtype),
+    )

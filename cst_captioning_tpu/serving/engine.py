@@ -1,0 +1,681 @@
+"""Step-driven continuous-batching scheduler over the compiled decode path.
+
+The offline decoders (``ops/sampling.py`` / ``ops/beam.py``) process a
+fixed batch from BOS to the all-finished predicate.  Serving traffic
+instead arrives one video at a time and finishes one caption at a time,
+so the engine runs the SAME per-step decode machinery — ``make_decode_step``
+with the PR-6 ``decode_kernel`` routing, the same greedy/beam step bodies
+— but owns the batch dimension as a set of SLOTS:
+
+- **Admission costs one encoder pass.**  A queued request is encoded at
+  batch 1 and its encoder outputs + fresh decoder carry are written into
+  the free slot's rows IN PLACE (``lax.dynamic_update_slice_in_dim`` at a
+  traced row index — one compiled admit program serves every slot).
+  Resident rows are never re-decoded.
+- **Each engine step runs one compiled chunk program**: ``decode_chunk``
+  decode steps over the whole slot batch as a fused ``lax.scan`` —
+  the PR-3 chunk geometry, so the tuned ``decode_chunk`` applies directly.
+- **A per-row finished predicate frees a slot mid-flight.**  The chunk
+  returns the per-beam finished buffer; ``ops.sampling.finished_mask``
+  (the same reduction the early-exit chunks use) tells the scheduler
+  which slots completed, and each freed slot admits the next queued video
+  before the following chunk.
+- **Bit-identity.**  A resident row's caption is bit-identical to the
+  offline ``eval.py`` decode of the same video (greedy and beam, either
+  decode kernel): the chunk bodies are the offline bodies with the
+  step-0 beam mask folded into the admission scores (an exactly-equal
+  formulation — see ``_build_beam_chunk``) and the per-slot force-finish
+  replacing the global step clamp.  Pinned by tests/test_serving.py.
+
+Programs compile once per bucket through ``buckets.ProgramCache``; under
+steady load the build counter must not move (SERVING.md).
+
+Threading: the engine is single-owner — ``submit``/``step``/``drain``
+must be called from one thread (the server's scheduler loop); front-end
+reader threads hand lines to that loop, never to the engine directly.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.beam import NEG_INF, _expand_to_beams, _reorder_beams
+from ..ops.sampling import finished_mask, make_decode_step
+from ..telemetry.spans import trace_span
+from .buckets import DEFAULT_BUCKETS, ProgramCache, config_key, pick_bucket
+
+#: Counters the engine owns (declared at 0 so snapshots distinguish
+#: "armed, nothing happened" from "feature absent" — registry.declare).
+COUNTERS = ("serve_requests", "serve_admitted", "serve_completed",
+            "serve_shed", "serve_rejected_drain", "serve_compiles")
+
+
+@dataclass
+class Request:
+    """One queued video: opaque id + per-modality ``(T, D)`` features."""
+
+    request_id: Any
+    feats: List[np.ndarray]
+    arrival: float = 0.0
+    meta: Optional[dict] = None
+
+
+@dataclass
+class Completion:
+    """One finished caption, 0-terminated in the label convention."""
+
+    request_id: Any
+    tokens: np.ndarray            # (max_len,) int32
+    slot: int
+    admit_at: float
+    done_at: float
+    latency_s: float
+    decode_steps: int
+    meta: Optional[dict] = None
+
+
+@dataclass
+class _Resident:
+    request: Request
+    slot: int
+    admit_at: float
+    steps: int = 0
+    toks: List[np.ndarray] = field(default_factory=list)
+    pars: List[np.ndarray] = field(default_factory=list)
+
+
+class ServingEngine:
+    """Continuous batching over the compiled greedy/beam decode.
+
+    ``variables`` is the flax variable dict (``{"params": params}``);
+    ``feat_shapes`` the per-modality ``(T, D)`` geometry every request
+    must match (one compiled admit program per bucket — a request with a
+    different feature shape is a config error, not a recompile).
+    ``queue_limit`` bounds the submit queue (0/None = unbounded, the
+    offline-parity mode); ``clock`` is injectable for deterministic
+    scheduler tests.
+    """
+
+    def __init__(self, model, variables, feat_shapes: Sequence[Tuple[int, int]],
+                 *, max_len: int, beam_size: int = 1, length_norm: float = 0.0,
+                 decode_chunk: int = 8,
+                 bucket_sizes: Sequence[int] = DEFAULT_BUCKETS,
+                 queue_limit: Optional[int] = 64,
+                 registry=None, tracer=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if getattr(model, "decoder_type", "lstm") != "lstm":
+            raise ValueError(
+                "serving requires per-row decoder state; the transformer "
+                "carry holds a batch-shared position counter, so a slot "
+                "admitted mid-flight cannot start at position 0 "
+                "(SERVING.md 'Model support')")
+        self.model = model
+        self._variables = variables
+        self._feat_shapes = tuple(tuple(int(x) for x in s)
+                                  for s in feat_shapes)
+        self.max_len = int(max_len)
+        self.beam_size = max(1, int(beam_size))
+        self.length_norm = float(length_norm)
+        chunk = int(decode_chunk)
+        # chunk 0 (legacy full-length scan) has no mid-caption boundary to
+        # recycle slots at; run it as one max_len-sized chunk (opts.py
+        # warns once when this combination is requested).
+        self.chunk = chunk if 0 < chunk < self.max_len else self.max_len
+        self.buckets = tuple(sorted(set(int(b) for b in bucket_sizes)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad bucket_sizes {bucket_sizes!r}")
+        self.queue_limit = int(queue_limit or 0)
+        self._registry = registry
+        self._tracer = tracer
+        self.clock = clock
+
+        self._cache = ProgramCache(registry)
+        self._queue: deque = deque()
+        self._residents: List[Optional[_Resident]] = []
+        self._slots_n = 0
+        self._dev: Optional[Dict[str, Any]] = None
+        self._latencies: deque = deque(maxlen=1024)
+        self._submitted = 0
+        self._completed = 0
+        self._shed = 0
+        self._rejected = 0
+        self._avals = self._request_avals()
+        for leaf in jax.tree_util.tree_leaves(self._avals[3]):
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != self.beam_size:
+                raise ValueError(
+                    "serving requires every decoder-carry leaf to be "
+                    f"per-row; got leaf shape {getattr(leaf, 'shape', ())}")
+        if registry is not None:
+            registry.declare(*COUNTERS)
+
+    # -- shapes and programs -----------------------------------------------
+
+    def _request_avals(self):
+        """Shapes/dtypes of one request's encoder outputs + carry (batch
+        ``beam_size`` rows), via ``eval_shape`` — no device work."""
+        k = self.beam_size
+        feats = [jax.ShapeDtypeStruct((1,) + s, jnp.float32)
+                 for s in self._feat_shapes]
+
+        def enc(variables, feats):
+            memory, proj_mem, pooled = self.model.apply(
+                variables, feats, method="encode")
+            if k > 1:
+                memory, proj_mem, pooled = _expand_to_beams(
+                    (memory, proj_mem, pooled), k, 1)
+            carry = self.model.apply(variables, pooled, self.max_len,
+                                     method="init_carry")
+            return memory, proj_mem, pooled, carry
+
+        return jax.eval_shape(enc, self._variables, feats)
+
+    def _config_key(self, slots: int, kind: str) -> tuple:
+        return config_key(
+            kind=kind, bucket=slots, beam_size=self.beam_size,
+            max_len=self.max_len, decode_chunk=self.chunk,
+            length_norm=self.length_norm,
+            decode_kernel=getattr(self.model, "decode_kernel", "reference"),
+            scan_unroll=getattr(self.model, "scan_unroll", 1),
+            feat_shapes=self._feat_shapes,
+            dtype=str(getattr(self.model, "dtype", jnp.float32)),
+        )
+
+    def _programs(self, slots: int) -> Dict[str, Callable]:
+        build = (self._build_beam_programs if self.beam_size > 1
+                 else self._build_greedy_programs)
+        return self._cache.get(self._config_key(slots, "programs"),
+                               lambda: build(slots))
+
+    def _init_state(self, slots: int) -> Dict[str, Any]:
+        """All-slots-empty device state: finished=True / steps=max_len so
+        empty rows are provable no-ops until an admission claims them."""
+        mem_a, proj_a, pooled_a, carry_a = self._avals
+        k = self.beam_size
+        rows = slots * k
+
+        def z(a):
+            return jnp.zeros((rows,) + tuple(a.shape[1:]), a.dtype)
+
+        state = {
+            "carry": jax.tree_util.tree_map(z, carry_a),
+            "memory": z(mem_a), "proj_mem": z(proj_a), "pooled": z(pooled_a),
+            "steps": jnp.full((slots,), self.max_len, jnp.int32),
+        }
+        if k == 1:
+            state["prev"] = jnp.zeros((slots,), jnp.int32)
+            state["finished"] = jnp.ones((slots,), bool)
+        else:
+            state["prev"] = jnp.zeros((slots, k), jnp.int32)
+            state["finished"] = jnp.ones((slots, k), bool)
+            state["scores"] = jnp.zeros((slots, k), jnp.float32)
+            state["lengths"] = jnp.zeros((slots, k), jnp.int32)
+        return state
+
+    def _build_admit(self, slots: int) -> Callable:
+        """One compiled program: encode one request (batch 1), expand to
+        beam rows, write encodings + fresh carry + reset per-slot columns
+        into ``row``'s rows of the donated state."""
+        k = self.beam_size
+        max_len = self.max_len
+        model = self.model
+
+        def fn(variables, state, feats, row):
+            memory, proj_mem, pooled = model.apply(variables, feats,
+                                                   method="encode")
+            if k > 1:
+                memory, proj_mem, pooled = _expand_to_beams(
+                    (memory, proj_mem, pooled), k, 1)
+            carry = model.apply(variables, pooled, max_len,
+                                method="init_carry")
+            r = row * k
+
+            def wr(buf, val):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, val.astype(buf.dtype), r, axis=0)
+
+            def wrow(buf, val):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    buf, jnp.asarray(val, buf.dtype)[None], row, axis=0)
+
+            new = dict(state)
+            new["carry"] = jax.tree_util.tree_map(wr, state["carry"], carry)
+            new["memory"] = wr(state["memory"], memory)
+            new["proj_mem"] = wr(state["proj_mem"], proj_mem)
+            new["pooled"] = wr(state["pooled"], pooled)
+            new["steps"] = wrow(state["steps"], 0)
+            if k == 1:
+                new["prev"] = wrow(state["prev"], 0)
+                new["finished"] = wrow(state["finished"], False)
+            else:
+                new["prev"] = wrow(state["prev"], jnp.zeros((k,), jnp.int32))
+                new["finished"] = wrow(state["finished"],
+                                       jnp.zeros((k,), bool))
+                # Step-0 beam mask as ADMISSION SCORES: only beam 0 live.
+                # (0 + logp) + NEG_INF == NEG_INF + logp bit-exactly, so
+                # this reproduces ops/beam.py's t==0 init_mask without a
+                # per-slot step counter inside the chunk body.
+                new["scores"] = wrow(
+                    state["scores"],
+                    jnp.full((k,), NEG_INF, jnp.float32).at[0].set(0.0))
+                new["lengths"] = wrow(state["lengths"],
+                                      jnp.zeros((k,), jnp.int32))
+            return new
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _build_greedy_programs(self, slots: int) -> Dict[str, Callable]:
+        chunk = self.chunk
+        max_len = self.max_len
+        model = self.model
+        unroll = getattr(model, "scan_unroll", 1)
+
+        def chunk_fn(variables, state):
+            step = make_decode_step(model, variables, state["memory"],
+                                    state["proj_mem"], state["pooled"])
+
+            # The offline greedy body (ops.sampling.sample_tokens,
+            # greedy=True) minus the unused logprob bookkeeping, plus a
+            # per-slot force-finish at max_len (a no-op while
+            # steps < max_len, so resident rows compute bit-identically).
+            def body(s, _):
+                carry, prev, finished, steps = s
+                finished = finished | (steps >= max_len)
+                carry, logits = step(carry, prev)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                emit = jnp.where(finished, 0, nxt)
+                finished = finished | (emit == 0)
+                return (carry, emit, finished, steps + 1), emit
+
+            (carry, prev, finished, steps), toks = jax.lax.scan(
+                body,
+                (state["carry"], state["prev"], state["finished"],
+                 state["steps"]),
+                None, length=chunk, unroll=unroll)
+            new = dict(state, carry=carry, prev=prev, finished=finished,
+                       steps=steps)
+            return new, toks.T                      # (slots, chunk)
+
+        return {"admit": self._build_admit(slots),
+                "chunk": jax.jit(chunk_fn, donate_argnums=(1,))}
+
+    def _build_beam_programs(self, slots: int) -> Dict[str, Callable]:
+        chunk = self.chunk
+        max_len = self.max_len
+        model = self.model
+        k = self.beam_size
+
+        def chunk_fn(variables, state):
+            step = make_decode_step(model, variables, state["memory"],
+                                    state["proj_mem"], state["pooled"])
+
+            # ops.beam.beam_search_tokens' body with the t==0 init mask
+            # handled by the admission scores (see _build_admit) and the
+            # body_clamped overrun guard made per-slot via ``steps``.
+            def body(s, _):
+                carry, prev, scores, finished, lengths, steps = s
+                finished = finished | (steps >= max_len)[:, None]
+                carry, logits = step(carry, prev.reshape(-1))
+                vocab = logits.shape[-1]
+                logp = jax.nn.log_softmax(logits, axis=-1).reshape(
+                    slots, k, vocab)
+                eos_only = jnp.full((vocab,), NEG_INF).at[0].set(0.0)
+                logp = jnp.where(finished[:, :, None],
+                                 eos_only[None, None, :], logp)
+                total = (scores[:, :, None] + logp).reshape(slots, k * vocab)
+                new_scores, flat = jax.lax.top_k(total, k)
+                parent = flat // vocab
+                token = (flat % vocab).astype(jnp.int32)
+                carry = _reorder_beams(carry, parent, slots, k)
+                was = jnp.take_along_axis(finished, parent, axis=1)
+                lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                lengths = lengths + jnp.where(was, 0, 1)
+                finished = was | (token == 0)
+                return (carry, token, new_scores, finished, lengths,
+                        steps + 1), (token, parent)
+
+            (carry, prev, scores, finished, lengths, steps), (toks, pars) = \
+                jax.lax.scan(
+                    body,
+                    (state["carry"], state["prev"], state["scores"],
+                     state["finished"], state["lengths"], state["steps"]),
+                    None, length=chunk)
+            new = dict(state, carry=carry, prev=prev, scores=scores,
+                       finished=finished, lengths=lengths, steps=steps)
+            # (chunk, slots, k) -> (slots, chunk, k) for per-slot harvest.
+            return new, (toks.transpose(1, 0, 2), pars.transpose(1, 0, 2))
+
+        return {"admit": self._build_admit(slots),
+                "chunk": jax.jit(chunk_fn, donate_argnums=(1,))}
+
+    # -- queue -------------------------------------------------------------
+
+    def submit(self, request_id, feats: Sequence[np.ndarray],
+               meta: Optional[dict] = None) -> bool:
+        """Queue one request.  Returns False (sheds) when the bounded
+        queue is full — the engine's backpressure signal; the front end
+        turns it into an explicit reject response."""
+        self._submitted += 1
+        self._inc("serve_requests")
+        feats = [np.asarray(f, np.float32) for f in feats]
+        shapes = tuple(f.shape for f in feats)
+        if shapes != self._feat_shapes:
+            raise ValueError(
+                f"request {request_id!r} feature shapes {shapes} do not "
+                f"match the engine's compiled geometry {self._feat_shapes}")
+        if self.queue_limit and len(self._queue) >= self.queue_limit:
+            self._shed += 1
+            self._inc("serve_shed")
+            self._update_gauges()
+            return False
+        self._queue.append(Request(request_id, feats,
+                                   arrival=self.clock(), meta=meta))
+        self._update_gauges()
+        return True
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not any(self._residents)
+
+    @property
+    def resident_count(self) -> int:
+        return sum(1 for r in self._residents if r is not None)
+
+    # -- scheduling --------------------------------------------------------
+
+    def _ensure_bucket(self) -> None:
+        needed = self.resident_count + len(self._queue)
+        if self._dev is None:
+            slots = pick_bucket(self.buckets, max(needed, 1))
+            self._dev = self._init_state(slots)
+            self._slots_n = slots
+            self._residents = [None] * slots
+            return
+        if needed <= self._slots_n:
+            return
+        target = pick_bucket(self.buckets, needed)
+        if target > self._slots_n:
+            self._grow(target)
+
+    def _grow(self, new_slots: int) -> None:
+        """Migrate to a larger bucket: pad every buffer with empty-slot
+        rows (finished=True / steps=max_len no-ops); residents keep their
+        slot indices, so nothing mid-caption is disturbed."""
+        k = self.beam_size
+        extra = new_slots - self._slots_n
+        old = self._dev
+
+        def pad(x, n, fill=0):
+            tail = jnp.full((n,) + x.shape[1:], fill, x.dtype)
+            return jnp.concatenate([x, tail], axis=0)
+
+        new = {
+            "carry": jax.tree_util.tree_map(
+                lambda x: pad(x, extra * k), old["carry"]),
+            "memory": pad(old["memory"], extra * k),
+            "proj_mem": pad(old["proj_mem"], extra * k),
+            "pooled": pad(old["pooled"], extra * k),
+            "prev": pad(old["prev"], extra),
+            "finished": pad(old["finished"], extra, fill=True),
+            "steps": pad(old["steps"], extra, fill=self.max_len),
+        }
+        if k > 1:
+            new["scores"] = pad(old["scores"], extra)
+            new["lengths"] = pad(old["lengths"], extra)
+        self._dev = new
+        self._residents.extend([None] * extra)
+        self._slots_n = new_slots
+
+    def _admit_pending(self) -> None:
+        if not self._queue:
+            return
+        programs = self._programs(self._slots_n)
+        for slot, res in enumerate(self._residents):
+            if res is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            with trace_span(self._tracer, "serve.admit"):
+                t0 = time.perf_counter()
+                feats = [jnp.asarray(f[None]) for f in req.feats]
+                self._dev = programs["admit"](self._variables, self._dev,
+                                              feats, slot)
+                admit_ms = (time.perf_counter() - t0) * 1e3
+            self._residents[slot] = _Resident(req, slot,
+                                              admit_at=self.clock())
+            self._inc("serve_admitted")
+            self._observe("serve_admit_ms", admit_ms)
+
+    def step(self) -> List[Completion]:
+        """One scheduler step: fill free slots from the queue, run ONE
+        compiled chunk over the slot batch, harvest every row whose
+        per-row finished mask went True (freeing its slot), refill.
+        Returns the completions harvested this step (possibly [])."""
+        self._ensure_bucket()
+        self._admit_pending()
+        done: List[Completion] = []
+        if self.resident_count == 0:
+            self._update_gauges()
+            return done
+        k = self.beam_size
+        programs = self._programs(self._slots_n)
+        with trace_span(self._tracer, "serve.decode_chunk"):
+            t0 = time.perf_counter()
+            self._dev, extras = programs["chunk"](self._variables, self._dev)
+            # The per-row predicate — the finished_mask helper the
+            # early-exit chunks share — reduced on device, fetched once.
+            fin = np.asarray(jax.device_get(
+                finished_mask(self._dev["finished"])))
+            if k == 1:
+                toks = np.asarray(jax.device_get(extras))
+                pars = None
+            else:
+                toks, pars = (np.asarray(x) for x in jax.device_get(extras))
+            chunk_ms = (time.perf_counter() - t0) * 1e3
+        self._observe("serve_decode_step_ms", chunk_ms / self.chunk)
+        scores_h = lengths_h = None
+        for slot, res in enumerate(self._residents):
+            if res is None:
+                continue
+            res.toks.append(toks[slot])
+            if pars is not None:
+                res.pars.append(pars[slot])
+            res.steps += self.chunk
+            if fin[slot] or res.steps >= self.max_len:
+                if k > 1 and scores_h is None:
+                    scores_h = np.asarray(jax.device_get(self._dev["scores"]))
+                    lengths_h = np.asarray(
+                        jax.device_get(self._dev["lengths"]))
+                done.append(self._harvest(slot, scores_h, lengths_h))
+        # Freed slots admit the next queued videos before the next chunk.
+        self._admit_pending()
+        self._update_gauges()
+        return done
+
+    def _harvest(self, slot: int, scores_h, lengths_h) -> Completion:
+        res = self._residents[slot]
+        self._residents[slot] = None
+        max_len = self.max_len
+        if self.beam_size == 1:
+            hist = np.concatenate(res.toks)[:max_len]
+            row = np.zeros((max_len,), np.int32)
+            row[:hist.shape[0]] = hist
+        else:
+            toks = np.concatenate(res.toks, axis=0)[:max_len]    # (T, k)
+            pars = np.concatenate(res.pars, axis=0)[:max_len]
+            row = _backtrack_best(toks, pars, scores_h[slot],
+                                  lengths_h[slot], max_len,
+                                  self.length_norm)
+        now = self.clock()
+        comp = Completion(
+            request_id=res.request.request_id, tokens=row, slot=slot,
+            admit_at=res.admit_at, done_at=now,
+            latency_s=now - res.request.arrival,
+            decode_steps=min(res.steps, max_len), meta=res.request.meta)
+        self._completed += 1
+        self._inc("serve_completed")
+        self._latencies.append(comp.latency_s)
+        self._observe("serve_request_latency_ms", comp.latency_s * 1e3)
+        return comp
+
+    def drain(self) -> Tuple[List[Completion], List[Request]]:
+        """Graceful shutdown: reject everything still queued, run the
+        resident rows to completion with admissions closed, return
+        (completions, rejected requests).  The SIGTERM contract
+        (SERVING.md 'Drain'); the caller maps it onto the resilience
+        exit-code taxonomy."""
+        rejected = list(self._queue)
+        self._queue.clear()
+        if rejected:
+            self._rejected += len(rejected)
+            self._inc("serve_rejected_drain", len(rejected))
+        done: List[Completion] = []
+        while any(r is not None for r in self._residents):
+            done.extend(self.step())
+        self._update_gauges()
+        return done, rejected
+
+    def run_until_idle(self) -> List[Completion]:
+        """Offline helper (eval parity / tests): step until queue and
+        slots are empty.  Progress is guaranteed — every resident
+        force-finishes at max_len steps."""
+        done: List[Completion] = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
+
+    # -- warmup / stats ----------------------------------------------------
+
+    def warm(self) -> Dict[str, Any]:
+        """Build AND execute admit+chunk for EVERY bucket on throwaway
+        state, so first requests hit compiled programs and steady load can
+        be pinned at 0 new builds (the bench probe's recompile assert).
+        Returns ``stats()`` — snapshot ``compiles`` to define "after
+        warmup"."""
+        for slots in self.buckets:
+            programs = self._programs(slots)
+            state = self._init_state(slots)
+            feats = [jnp.zeros((1,) + s, jnp.float32)
+                     for s in self._feat_shapes]
+            state = programs["admit"](self._variables, state, feats, 0)
+            state, extras = programs["chunk"](self._variables, state)
+            jax.block_until_ready(extras)
+        return self.stats()
+
+    def stats(self) -> Dict[str, Any]:
+        lat = np.asarray(self._latencies, np.float64) * 1e3
+        pct = (lambda q: float(np.percentile(lat, q)) if lat.size else None)
+        return {
+            "slots": self._slots_n,
+            "buckets": list(self.buckets),
+            "beam_size": self.beam_size,
+            "decode_chunk": self.chunk,
+            "residents": self.resident_count,
+            "queue_depth": len(self._queue),
+            "submitted": self._submitted,
+            "completed": self._completed,
+            "shed": self._shed,
+            "rejected_drain": self._rejected,
+            "compiles": self._cache.builds,
+            "latency_p50_ms": pct(50),
+            "latency_p99_ms": pct(99),
+            "latency_mean_ms": float(lat.mean()) if lat.size else None,
+        }
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _inc(self, name: str, n: float = 1) -> None:
+        if self._registry is not None:
+            self._registry.inc(name, n)
+
+    def _observe(self, name: str, value: float) -> None:
+        if self._registry is not None:
+            self._registry.observe(name, value)
+
+    def _update_gauges(self) -> None:
+        if self._registry is None:
+            return
+        self._registry.set_gauge("serve_queue_depth", len(self._queue))
+        self._registry.set_gauge(
+            "serve_slot_occupancy",
+            self.resident_count / self._slots_n if self._slots_n else 0.0)
+        self._registry.set_gauge("serve_recompiles", self._cache.builds)
+        if self._latencies:
+            lat = np.asarray(self._latencies, np.float64) * 1e3
+            self._registry.set_gauge("serve_latency_p50_ms",
+                                     float(np.percentile(lat, 50)))
+            self._registry.set_gauge("serve_latency_p99_ms",
+                                     float(np.percentile(lat, 99)))
+
+
+def _backtrack_best(toks: np.ndarray, pars: np.ndarray, scores: np.ndarray,
+                    lengths: np.ndarray, max_len: int,
+                    length_norm: float) -> np.ndarray:
+    """Host-side twin of ops/beam.py's backtrack + ranking for ONE slot.
+
+    ``toks``/``pars`` are the slot's executed steps (T <= max_len; chunk
+    steps past a slot's finish are the provable all-finished no-op —
+    token 0 at parent identity — so backtracking through them reproduces
+    the legacy full-length backtrack, the same argument the PR-3 chunked
+    beam rides on).  Ranking runs through jnp so pow/argsort tie-breaking
+    match the compiled path exactly.
+    """
+    T, k = toks.shape
+    beam_ix = np.arange(k)
+    seq = np.zeros((k, max_len), np.int32)
+    for t in range(T - 1, -1, -1):
+        seq[:, t] = toks[t, beam_ix]
+        beam_ix = pars[t, beam_ix]
+    ranked = jnp.asarray(scores)
+    if length_norm > 0:
+        ranked = ranked / jnp.maximum(jnp.asarray(lengths), 1) ** length_norm
+    order = np.asarray(jnp.argsort(-ranked))
+    return seq[int(order[0])]
+
+
+def serve_decode_split(model, params, loader, vocab, max_len: int,
+                       beam_size: int = 1, length_norm: float = 0.0,
+                       decode_chunk: int = 8,
+                       bucket_sizes: Sequence[int] = DEFAULT_BUCKETS,
+                       registry=None, tracer=None, beat=None):
+    """Decode a whole split through the serving engine (batch-offline
+    load) -> ``[{"image_id", "caption"}]`` in dataset order.
+
+    The offline twin of ``training.evaluation.decode_split``: every video
+    is submitted once (padding dupes skipped), the engine runs to idle,
+    captions decode through the same vocab.  ``eval.py --engine serving``
+    asserts this output caption-for-caption equal to the legacy path —
+    the end-to-end parity drill.
+    """
+    ds = loader.ds
+    engine = ServingEngine(
+        model, {"params": params},
+        list(zip(ds.feat_times, ds.feat_dims)),
+        max_len=max_len, beam_size=beam_size, length_norm=length_norm,
+        decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
+        queue_limit=0, registry=registry, tracer=tracer)
+    seen = set()
+    order = []
+    tokens = {}
+    for batch in loader.iter_eval():
+        for j, vid in enumerate(batch.video_ids):
+            if vid in seen:
+                continue
+            seen.add(vid)
+            order.append(vid)
+            engine.submit(vid, [np.asarray(f)[j] for f in batch.feats])
+        # Overlap decode with the next batch's feature reads.
+        for comp in engine.step():
+            tokens[comp.request_id] = comp.tokens
+        if beat is not None:
+            beat()
+    for comp in engine.run_until_idle():
+        tokens[comp.request_id] = comp.tokens
+    return [{"image_id": vid, "caption": vocab.decode(tokens[vid])}
+            for vid in order]

@@ -1,0 +1,240 @@
+"""Front end for the serving engine: stdin/JSONL + optional localhost socket.
+
+Protocol (one JSON object per line, either direction):
+
+  request:   {"id": <any>, "video_id": "<key>"}
+  response:  {"id", "video_id", "caption", "latency_ms", "decode_steps"}
+  reject:    {"id", "error": "shed" | "bad_request" | "unknown_video"
+                            | "rejected_draining", ...}
+
+Scheduling model: reader threads (stdin, or one per socket connection)
+only parse lines into a thread-safe inbox; the single scheduler loop owns
+the engine — submit, step, respond.  Backpressure is explicit: when the
+engine's bounded queue sheds a request the client gets ``"error": "shed"``
+immediately instead of silently growing latency.
+
+Shutdown contract (SERVING.md "Drain"): a SIGTERM/SIGINT (via the shared
+``resilience.preemption.PreemptionHandler``) closes admissions, DRAINS
+the in-flight residents to completion, answers everything still queued
+with ``rejected_draining``, and exits ``exitcodes.EXIT_PREEMPTED`` (75) —
+the same resumable classification the training loop uses, so a fleet
+harness treats a drained server exactly like a preempted trainer.
+Stdin EOF is the natural end: finish everything, exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED
+from .engine import Completion, ServingEngine
+
+
+class CaptionServer:
+    """Line-protocol server around one :class:`ServingEngine`.
+
+    ``feats_for(video_id)`` -> per-modality feature list (or None for an
+    unknown id) — the deployment decides where features come from (h5
+    lookup, upstream extractor, demo table).  ``handler`` is anything with
+    a ``requested`` bool (the preemption handler, or a test stub).
+    """
+
+    def __init__(self, engine: ServingEngine, vocab, feats_for,
+                 *, handler=None, out=None, idle_sleep: float = 0.002):
+        self.engine = engine
+        self.vocab = vocab
+        self.feats_for = feats_for
+        self.handler = handler
+        self.out = out if out is not None else sys.stdout
+        self.idle_sleep = idle_sleep
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._eof = threading.Event()
+        self._write_lock = threading.Lock()
+
+    # -- responses ---------------------------------------------------------
+
+    def _write(self, respond: Callable[[str], None], obj: Dict[str, Any]):
+        with self._write_lock:
+            respond(json.dumps(obj))
+
+    def _respond_completion(self, comp: Completion) -> None:
+        meta = comp.meta or {}
+        respond = meta.get("respond", self._stdout_respond)
+        self._write(respond, {
+            "id": meta.get("id"),
+            "video_id": meta.get("video_id"),
+            "caption": self.vocab.decode(comp.tokens),
+            "latency_ms": round(comp.latency_s * 1e3, 3),
+            "decode_steps": int(comp.decode_steps),
+        })
+
+    def _stdout_respond(self, line: str) -> None:
+        self.out.write(line + "\n")
+        self.out.flush()
+
+    # -- request intake (reader threads -> inbox -> scheduler loop) --------
+
+    def _handle_line(self, line: str, respond: Callable[[str], None]):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+        except ValueError:
+            self._write(respond, {"id": None, "error": "bad_request",
+                                  "detail": "unparseable JSON line"})
+            return
+        if not isinstance(req, dict):
+            self._write(respond, {"id": None, "error": "bad_request",
+                                  "detail": "expected {'id', 'video_id'}"})
+            return
+        rid = req.get("id")
+        vid = req.get("video_id")
+        if vid is None:
+            self._write(respond, {"id": rid, "error": "bad_request",
+                                  "detail": "expected {'id', 'video_id'}"})
+            return
+        feats = self.feats_for(vid)
+        if feats is None:
+            self._write(respond, {"id": rid, "error": "unknown_video",
+                                  "video_id": vid})
+            return
+        try:
+            ok = self.engine.submit(
+                (rid, vid), [np.asarray(f) for f in feats],
+                meta={"id": rid, "video_id": vid, "respond": respond})
+        except ValueError as e:
+            self._write(respond, {"id": rid, "error": "bad_request",
+                                  "detail": str(e)})
+            return
+        if not ok:
+            self._write(respond, {"id": rid, "error": "shed",
+                                  "video_id": vid,
+                                  "queue_depth": self.engine.stats()
+                                  ["queue_depth"]})
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _drain_and_exit(self) -> int:
+        done, rejected = self.engine.drain()
+        for comp in done:
+            self._respond_completion(comp)
+        for req in rejected:
+            meta = req.meta or {}
+            self._write(meta.get("respond", self._stdout_respond),
+                        {"id": meta.get("id"),
+                         "video_id": meta.get("video_id"),
+                         "error": "rejected_draining"})
+        print(f"serve: drained {len(done)} in-flight, rejected "
+              f"{len(rejected)} queued; exiting "
+              f"{EXIT_PREEMPTED} (preempted/resumable)", file=sys.stderr)
+        return EXIT_PREEMPTED
+
+    def _loop(self) -> int:
+        while True:
+            if self.handler is not None and self.handler.requested:
+                return self._drain_and_exit()
+            moved = False
+            while True:
+                try:
+                    line, respond = self._inbox.get_nowait()
+                except queue.Empty:
+                    break
+                self._handle_line(line, respond)
+                moved = True
+            comps = self.engine.step()
+            for comp in comps:
+                self._respond_completion(comp)
+            if comps:
+                moved = True
+            if self._eof.is_set() and self.engine.idle \
+                    and self._inbox.empty():
+                return EXIT_OK
+            if not moved and self.engine.idle:
+                time.sleep(self.idle_sleep)
+
+    # -- stdin front end ---------------------------------------------------
+
+    def run_stdin(self, lines=None) -> int:
+        """Serve JSONL requests from ``lines`` (default: sys.stdin) until
+        EOF (exit 0) or a preemption signal (drain, exit 75)."""
+        src = lines if lines is not None else sys.stdin
+
+        def read():
+            try:
+                for line in src:
+                    self._inbox.put((line, self._stdout_respond))
+            finally:
+                self._eof.set()
+
+        threading.Thread(target=read, name="serve-stdin",
+                         daemon=True).start()
+        return self._loop()
+
+    # -- localhost socket front end ---------------------------------------
+
+    def run_socket(self, port: int) -> int:
+        """Serve line-protocol requests on 127.0.0.1:``port`` (0 = pick an
+        ephemeral port; the bound port is announced on stderr as
+        ``serve: listening on 127.0.0.1:<port>``).  Runs until a
+        preemption signal drains it."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", int(port)))
+        srv.listen()
+        srv.settimeout(0.2)
+        bound = srv.getsockname()[1]
+        print(f"serve: listening on 127.0.0.1:{bound}", file=sys.stderr)
+        sys.stderr.flush()
+        conns: List[socket.socket] = []
+
+        def reader(conn: socket.socket) -> None:
+            lock = threading.Lock()
+
+            def respond(line: str) -> None:
+                with lock:
+                    try:
+                        conn.sendall(line.encode() + b"\n")
+                    except OSError:
+                        pass  # client went away; the caption is dropped
+
+            try:
+                with conn.makefile("r", encoding="utf-8",
+                                   errors="replace") as f:
+                    for line in f:
+                        self._inbox.put((line, respond))
+            except OSError:
+                pass
+
+        def accept() -> None:
+            while not self._eof.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                conns.append(conn)
+                threading.Thread(target=reader, args=(conn,),
+                                 name="serve-conn", daemon=True).start()
+
+        threading.Thread(target=accept, name="serve-accept",
+                         daemon=True).start()
+        try:
+            return self._loop()
+        finally:
+            self._eof.set()  # stops the accept loop
+            for conn in conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            srv.close()

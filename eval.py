@@ -66,6 +66,48 @@ def load_model_for_eval(checkpoint_path: str, dataset: CaptionDataset,
     return model, params, opt
 
 
+def eval_via_serving_engine(model, params, loader, ds, opt, beat=None):
+    """--engine serving: decode the split through the continuous-batching
+    engine AND the legacy compiled decode, assert caption-for-caption
+    equality, then score the serving predictions.  A mismatch is a FATAL
+    parity break (exit 1 via the raised error) — the serving engine's
+    whole contract is that it changes scheduling, never captions."""
+    from cst_captioning_tpu.metrics.coco_eval import language_eval
+    from cst_captioning_tpu.serving.buckets import parse_buckets
+    from cst_captioning_tpu.serving.engine import serve_decode_split
+    from cst_captioning_tpu.training.evaluation import decode_split
+
+    kw = dict(max_len=opt.max_length, beam_size=opt.beam_size,
+              length_norm=opt.length_norm,
+              decode_chunk=getattr(opt, "decode_chunk", 0))
+    legacy = decode_split(model, params, loader, ds.vocab, kw["max_len"],
+                          beam_size=kw["beam_size"],
+                          length_norm=kw["length_norm"], beat=beat,
+                          decode_chunk=kw["decode_chunk"])
+    serving = serve_decode_split(
+        model, params, loader, ds.vocab, kw["max_len"],
+        beam_size=kw["beam_size"], length_norm=kw["length_norm"],
+        decode_chunk=kw["decode_chunk"],
+        bucket_sizes=parse_buckets(getattr(opt, "serve_buckets", "1,4,8")),
+        beat=beat)
+    by_id = {p["image_id"]: p["caption"] for p in legacy}
+    mismatch = [(p["image_id"], by_id.get(p["image_id"]), p["caption"])
+                for p in serving if by_id.get(p["image_id"]) != p["caption"]]
+    if len(serving) != len(legacy) or mismatch:
+        detail = "; ".join(
+            f"{vid}: legacy={a!r} serving={b!r}"
+            for vid, a, b in mismatch[:5])
+        raise RuntimeError(
+            f"serving-engine parity FAILED: {len(mismatch)} of "
+            f"{len(legacy)} captions differ from the legacy decode "
+            f"({detail})")
+    log.info("serving-engine parity: %d captions bit-identical to the "
+             "legacy decode", len(serving))
+    if beat is not None:
+        beat()
+    return serving, language_eval(serving, ds.references())
+
+
 def main(argv=None) -> int:
     opt = parse_opts(argv)
     from cst_captioning_tpu.utils.platform import (configure_cli_logging,
@@ -104,15 +146,24 @@ def main(argv=None) -> int:
         loader = CaptionLoader(
             ds, batch_size=opt.eval_batch_size or opt.batch_size,
             seq_per_img=1, shuffle=False)
-        mesh = make_mesh(jax.devices())  # decode shards over every chip
-        preds, scores = eval_split(
-            model, params, loader, ds.vocab, opt.max_length,
-            ds.references(),
-            beam_size=opt.beam_size, length_norm=opt.length_norm,
-            mesh=mesh,
-            beat=watchdog.beat,
-            decode_chunk=getattr(opt, "decode_chunk", 0),
-        )
+        if getattr(opt, "engine", "legacy") == "serving":
+            # Serving-engine decode at batch-offline load, pinned
+            # caption-for-caption against the legacy compiled decode —
+            # the engine's end-to-end parity drill (SERVING.md).  Both
+            # paths run single-device so the comparison is apples to
+            # apples (the sharded legacy decode is pinned elsewhere).
+            preds, scores = eval_via_serving_engine(
+                model, params, loader, ds, opt, beat=watchdog.beat)
+        else:
+            mesh = make_mesh(jax.devices())  # decode shards over every chip
+            preds, scores = eval_split(
+                model, params, loader, ds.vocab, opt.max_length,
+                ds.references(),
+                beam_size=opt.beam_size, length_norm=opt.length_norm,
+                mesh=mesh,
+                beat=watchdog.beat,
+                decode_chunk=getattr(opt, "decode_chunk", 0),
+            )
     log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
     if opt.result_file:
         with open(opt.result_file, "w") as f:

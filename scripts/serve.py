@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Caption-serving CLI: continuous batching over the compiled decode path.
+
+Front end for ``cst_captioning_tpu/serving/`` (SERVING.md).  Two backends:
+
+- **checkpoint mode** (default): load a stage's BEST checkpoint exactly
+  like eval.py, serve the test split's videos by id —
+
+    python scripts/serve.py --checkpoint_path <dir> \\
+        --test_feat_h5 ... --test_label_h5 ... --test_info_json ... \\
+        --beam_size 1 --serve_queue_limit 64
+
+- **demo mode** (``--serve_demo 1``): zero-setup tiny untrained model +
+  synthetic feature table (ids ``v0``..``v15``); captions are gibberish,
+  the serving path — admission, slot recycling, backpressure, drain — is
+  the real one.  ``make serve-demo`` pipes a few requests through it.
+
+Protocol: one JSON object per line on stdin/stdout (or, with
+``--serve_port``, on a localhost socket):
+
+    {"id": 1, "video_id": "v3"}
+    -> {"id": 1, "video_id": "v3", "caption": ..., "latency_ms": ...}
+
+Shutdown: SIGTERM/SIGINT drains in-flight requests, rejects queued ones,
+and exits 75 (``resilience/exitcodes.EXIT_PREEMPTED``, resumable); stdin
+EOF finishes everything and exits 0.  Engine stats land on stderr and —
+when ``--result_file`` is set — as a JSON stats file.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from cst_captioning_tpu.opts import parse_opts  # noqa: E402
+
+log = logging.getLogger("cst_captioning_tpu.serve")
+
+DEMO_WORDS = ("a", "man", "woman", "dog", "is", "playing", "running",
+              "cooking", "guitar", "outside", "the", "park", "ball",
+              "talking", "singing", "fast")
+DEMO_VIDEOS = 16
+DEMO_FEAT_SHAPES = ((4, 16), (1, 8))
+
+
+def build_demo_backend(opt):
+    """Tiny untrained EOS-biased model + seeded feature table -> the
+    (model, params, vocab, feat_shapes, feats_for) quintet."""
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.models import CaptionModel
+
+    vocab = Vocab({i + 1: w for i, w in enumerate(DEMO_WORDS)})
+    model = CaptionModel(
+        vocab_size=vocab.size_with_pad, embed_size=16, hidden_size=16,
+        attn_size=16, dropout_rate=0.0,
+        decode_kernel=getattr(opt, "decode_kernel", "reference"))
+    feats0 = [jnp.zeros((1,) + s, jnp.float32) for s in DEMO_FEAT_SHAPES]
+    variables = model.init(jax.random.PRNGKey(0), feats0,
+                           np.zeros((1, opt.max_length), np.int32))
+    params = {**variables["params"]}
+    params["logit"] = {**params["logit"]}
+    # Bias EOS so untrained captions terminate in a few steps (the
+    # bench-probe trick) — the demo shows scheduling, not caption quality.
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(0.2)
+    rng = np.random.default_rng(0)
+    table = [rng.standard_normal((DEMO_VIDEOS,) + s).astype(np.float32)
+             for s in DEMO_FEAT_SHAPES]
+
+    def feats_for(video_id):
+        try:
+            ix = int(str(video_id).lstrip("v"))
+        except ValueError:
+            return None
+        if not 0 <= ix < DEMO_VIDEOS:
+            return None
+        return [t[ix] for t in table]
+
+    return model, params, vocab, list(DEMO_FEAT_SHAPES), feats_for
+
+
+def build_checkpoint_backend(opt, ds):
+    """eval.py's checkpoint restore + an h5-lookup feats_for."""
+    from eval import load_model_for_eval
+
+    model, params, opt = load_model_for_eval(opt.checkpoint_path, ds, opt)
+    row_of = {vid: i for i, vid in enumerate(ds.video_ids)}
+
+    def feats_for(video_id):
+        ix = row_of.get(str(video_id))
+        if ix is None:
+            return None
+        return [np.asarray(f)[0] for f in ds.features(np.asarray([ix]))]
+
+    return model, params, ds.vocab, \
+        list(zip(ds.feat_times, ds.feat_dims)), feats_for, opt
+
+
+def main(argv=None) -> int:
+    opt = parse_opts(argv)
+    from cst_captioning_tpu.opts import warn_serving_decode_chunk
+    from cst_captioning_tpu.utils.platform import (configure_cli_logging,
+                                                   enable_compile_cache)
+
+    configure_cli_logging(opt.loglevel)
+    warn_serving_decode_chunk(opt)
+    enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
+
+    from cst_captioning_tpu.resilience.preemption import PreemptionHandler
+    from cst_captioning_tpu.serving.buckets import parse_buckets
+    from cst_captioning_tpu.serving.engine import ServingEngine
+    from cst_captioning_tpu.serving.server import CaptionServer
+    from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+    handler = PreemptionHandler().install()
+    registry = MetricsRegistry()
+
+    ds = None
+    if opt.serve_demo:
+        model, params, vocab, feat_shapes, feats_for = \
+            build_demo_backend(opt)
+    else:
+        from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+
+        if not opt.test_feat_h5:
+            print("serve.py: checkpoint mode needs --test_feat_h5/"
+                  "--test_label_h5/--test_info_json (or pass "
+                  "--serve_demo 1)", file=sys.stderr)
+            return 2
+        ds = CaptionDataset(SplitPaths(
+            feat_h5=list(opt.test_feat_h5), label_h5=opt.test_label_h5,
+            info_json=opt.test_info_json,
+            cocofmt_json=opt.test_cocofmt_file))
+        model, params, vocab, feat_shapes, feats_for, opt = \
+            build_checkpoint_backend(opt, ds)
+
+    tracer = None
+    if getattr(opt, "trace_dir", None):
+        from cst_captioning_tpu.telemetry.spans import SpanTracer
+
+        tracer = SpanTracer(opt.trace_dir)
+
+    engine = ServingEngine(
+        model, {"params": params}, feat_shapes,
+        max_len=opt.max_length, beam_size=opt.beam_size,
+        length_norm=opt.length_norm,
+        decode_chunk=getattr(opt, "decode_chunk", 8),
+        bucket_sizes=parse_buckets(opt.serve_buckets),
+        queue_limit=opt.serve_queue_limit,
+        registry=registry, tracer=tracer)
+    engine.warm()
+    log.info("engine warm: buckets=%s beam=%d chunk=%d queue_limit=%d",
+             engine.buckets, engine.beam_size, engine.chunk,
+             opt.serve_queue_limit)
+
+    server = CaptionServer(engine, vocab, feats_for, handler=handler)
+    try:
+        if opt.serve_port:
+            port = 0 if opt.serve_port < 0 else opt.serve_port
+            rc = server.run_socket(port)
+        else:
+            rc = server.run_stdin()
+    finally:
+        stats = engine.stats()
+        print("serve: " + json.dumps(stats), file=sys.stderr)
+        if opt.result_file:
+            from cst_captioning_tpu.resilience.integrity import (
+                atomic_json_write,
+            )
+
+            atomic_json_write(opt.result_file,
+                              {"stats": stats,
+                               "telemetry": registry.snapshot()}, indent=2)
+        if tracer is not None:
+            tracer.close()
+        if ds is not None:
+            ds.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Summarize a serving-probe JSON line into a terminal latency table.
+
+Reads the one-JSON-line artifact `bench.py --stage serving` prints (from
+stdin, a file, or the newest BENCH_TPU_CACHE entry) and renders the
+latency/throughput picture a human wants at a glance:
+
+  python bench.py --stage serving | python scripts/serve_report.py
+  python scripts/serve_report.py --file serving.json
+  python scripts/serve_report.py --cache          # last cached device run
+
+Exit 1 when no serving record could be found/parsed (a report that
+silently prints nothing would hide a broken probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_METRIC = "serve_captions_per_sec_per_chip"
+
+
+def find_record(args) -> dict | None:
+    """First parseable serving JSON line from the chosen source."""
+    if args.cache:
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_CACHE.json")) as f:
+                entry = json.load(f)["entries"].get(SERVE_METRIC)
+            return entry and entry.get("result")
+        except (OSError, ValueError, KeyError):
+            return None
+    lines = open(args.file) if args.file else sys.stdin
+    try:
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric") == SERVE_METRIC:
+                return rec
+    finally:
+        if args.file:
+            lines.close()
+    return None
+
+
+def fmt(v, unit="") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.2f}{unit}"
+    return f"{v}{unit}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--file", default=None,
+                   help="read the JSON line from this file (default: stdin)")
+    p.add_argument("--cache", action="store_true",
+                   help="read the last cached device serving entry instead")
+    args = p.parse_args(argv)
+    rec = find_record(args)
+    if not rec:
+        print("serve_report: no serving-probe JSON line found "
+              f"(metric {SERVE_METRIC!r}); run "
+              "`python bench.py --stage serving`", file=sys.stderr)
+        return 1
+    rows = [
+        ("captions/s", fmt(rec.get("value"))),
+        ("latency p50", fmt(rec.get("latency_p50_ms"), " ms")),
+        ("latency p99", fmt(rec.get("latency_p99_ms"), " ms")),
+        ("latency mean", fmt(rec.get("latency_mean_ms"), " ms")),
+        ("requests", f"{fmt(rec.get('completed'))} completed / "
+                     f"{fmt(rec.get('num_requests'))} offered "
+                     f"({fmt(rec.get('shed'))} shed)"),
+        ("arrival rate", fmt(rec.get("rate_hz"), " req/s (Poisson, seed "
+                             f"{rec.get('arrival_seed')})")),
+        ("makespan", fmt(rec.get("makespan_s"), " s")),
+        ("buckets", f"{rec.get('buckets')} -> ran at "
+                    f"{fmt(rec.get('slots'))} slots"),
+        ("beam / chunk", f"{fmt(rec.get('beam_size'))} / "
+                         f"{fmt(rec.get('decode_chunk'))}"),
+        ("recompiles after warmup", fmt(rec.get("recompiles_after_warmup"))),
+        ("platform", f"{rec.get('platform')}"
+                     + (" (CPU FALLBACK — not a device number)"
+                        if rec.get("cpu_fallback") else "")),
+    ]
+    width = max(len(k) for k, _ in rows)
+    print("serving probe" + (f" [{rec.get('metric')}]" if rec.get("metric")
+                             else ""))
+    for k, v in rows:
+        print(f"  {k:<{width}}  {v}")
+    recomp = rec.get("recompiles_after_warmup")
+    if recomp not in (0, None):
+        print("  !! recompiles under steady load: the bucket discipline "
+              "is broken (SERVING.md)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,13 @@ force_cpu_platform()
 # train/eval/bench children inherit this isolation from the environment.
 os.environ["CST_TUNED_CONFIGS"] = ""
 
+# Same hermeticity for the serving engine's env knobs: an operator's
+# exported bucket ladder / queue bound (opts.py resolves CST_SERVE_* as
+# argparse defaults) must not change what the suite pins.  '' falls back
+# to the built-in defaults; serving tests pass explicit values instead.
+os.environ["CST_SERVE_BUCKETS"] = ""
+os.environ["CST_SERVE_QUEUE_LIMIT"] = ""
+
 import jax  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu", (
