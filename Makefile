@@ -33,7 +33,7 @@ TRAIN_COMMON = \
 
 .PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo trace-demo \
         scale_chain report collect chip_window tune tune-fast tune-report \
-        serve-demo serve-bench clean
+        serve-demo serve-bench serve-chaos clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -168,6 +168,16 @@ serve-bench:
 	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
 	  --serve_requests 12 --serve_rate 6 > /tmp/cst_serve_bench.json
 	$(PY) scripts/serve_report.py --file /tmp/cst_serve_bench.json
+
+# Serving chaos drills (RESILIENCE.md "Serving faults"): the seeded
+# serve_wedge/serve_garble/admit_err fault plans through the self-healing
+# scheduler — captions bit-identical to the fault-free twin, zero
+# post-warmup compiles including across an engine rebuild, counters
+# reflecting every injected fault — plus the deadline/TTL eviction units
+# and the double-SIGTERM drain drill.  Includes the `slow` subprocess
+# drills tier-1 skips; the fast slice rides in tier-1 automatically.
+serve-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving_resilience.py -q
 
 # -- zero-setup synthetic demo --------------------------------------------
 

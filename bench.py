@@ -550,6 +550,12 @@ def parse_args():
     p.add_argument("--probe_timeout", type=float, default=120.0,
                    help="seconds before one backend-init probe is declared wedged")
     p.add_argument("--probe_retries", type=int, default=2)
+    p.add_argument("--probe_backoff", type=float, default=2.0,
+                   help="seconds of linear backoff between backend-probe "
+                        "retries (attempt N waits N*backoff); the retry "
+                        "record + per-attempt latencies land in the JSON "
+                        "line's 'probe' field, labeled kind=probe_error/"
+                        "probe_timeout when every attempt failed")
     p.add_argument("--child_timeout", type=float, default=1800.0,
                    help="seconds for ONE measurement child process (a "
                         "wedge-mid-measurement worst case pays this twice: "
@@ -773,7 +779,8 @@ def run_measurement(args) -> None:
     }, args)
 
 
-def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
+def probe_backend(timeout_s: float, retries: int,
+                  backoff_s: float = 0.0) -> tuple[str | None, dict]:
     """Initialize the default jax backend in a throwaway subprocess.
 
     Returns ``(platform, probe_info)``: the platform string (None if every
@@ -782,7 +789,11 @@ def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
     absorb the hang) plus a telemetry record of every attempt.
     ``probe_info`` rides into the emitted JSON so three silent 120s
     timeouts (BENCH_r05) become an auditable
-    ``{"attempts": [...], "timeouts": 3}`` instead of stderr-only noise.
+    ``{"kind": "probe_error", "attempts": [...], "timeouts": 3}`` — a
+    machine-readable diagnostic for the chip-window regression — instead
+    of stderr-only noise.  Retries back off linearly (``backoff_s``,
+    ``2 * backoff_s``, ...): a tunnel mid-reconnect gets a window to come
+    back instead of three instant identical failures.
 
     The probe child runs in its own process group with output to temp
     files, not pipes: a wedged PJRT plugin can spawn helper processes that
@@ -793,7 +804,8 @@ def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
     import signal
     import tempfile
 
-    info: dict = {"attempts": [], "timeouts": 0, "timeout_s": timeout_s}
+    info: dict = {"attempts": [], "timeouts": 0, "timeout_s": timeout_s,
+                  "backoff_s": backoff_s}
 
     def done(outcome: str, t0: float, platform: str | None = None):
         rec = {"outcome": outcome,
@@ -801,6 +813,14 @@ def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
         if platform is not None:
             rec["platform"] = platform
         info["attempts"].append(rec)
+
+    def backoff(attempt: int) -> None:
+        if backoff_s > 0 and attempt < retries:
+            wait = backoff_s * (attempt + 1)
+            info["attempts"][-1]["backoff_s"] = wait
+            print(f"bench: backing off {wait:.1f}s before probe retry",
+                  file=sys.stderr)
+            time.sleep(wait)
 
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     for attempt in range(retries + 1):
@@ -823,6 +843,7 @@ def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
                 info["timeouts"] += 1
                 print(f"bench: backend probe timed out ({timeout_s:.0f}s), "
                       f"attempt {attempt + 1}/{retries + 1}", file=sys.stderr)
+                backoff(attempt)
                 continue
             out.seek(0)
             for line in out.read().splitlines():
@@ -835,6 +856,12 @@ def probe_backend(timeout_s: float, retries: int) -> tuple[str | None, dict]:
             print(f"bench: backend probe rc={proc.returncode}, attempt "
                   f"{attempt + 1}/{retries + 1}\n{err.read()[-2000:]}",
                   file=sys.stderr)
+            backoff(attempt)
+    # Total probe failure: label the record so the bench JSON carries a
+    # classified, machine-auditable diagnostic (not just a cpu_fallback
+    # flag a reader has to interpret).
+    info["kind"] = ("probe_timeout" if info["timeouts"] == len(
+        info["attempts"]) else "probe_error")
     return None, info
 
 
@@ -942,7 +969,8 @@ def main():
     cpu_fallback = False
     if args.platform in ("auto", "device"):
         plat, probe_info = probe_backend(args.probe_timeout,
-                                         args.probe_retries)
+                                         args.probe_retries,
+                                         backoff_s=args.probe_backoff)
         if plat is not None and plat != "cpu":
             use_device = True
         elif args.platform == "device":

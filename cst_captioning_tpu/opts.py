@@ -369,6 +369,65 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "(tiny untrained EOS-biased model + synthetic "
                         "feature table; captions are gibberish, the "
                         "serving path is real)")
+    g.add_argument("--serve_demo_eos_bias", type=float, default=0.2,
+                   help="scripts/serve.py --serve_demo 1: EOS-logit bias "
+                        "of the demo model.  The default terminates demo "
+                        "captions in a few steps (snappy demo); negative "
+                        "values suppress EOS so captions run the full "
+                        "--max_length — the drain/deadline chaos drills "
+                        "use this to hold residents in flight "
+                        "deterministically")
+    g.add_argument("--serve_deadline_ms",
+                   type=_nonneg_int(
+                       "--serve_deadline_ms (or CST_SERVE_DEADLINE_MS)",
+                       "no deadline"),
+                   default=os.environ.get("CST_SERVE_DEADLINE_MS") or 0,
+                   help="default per-request deadline: a request not "
+                        "completed this many ms after submission is "
+                        "EVICTED mid-flight (slot recycled, response "
+                        "'expired'), and a queued request whose deadline "
+                        "cannot cover one p99 decode chunk is shed "
+                        "(SERVING.md 'Deadlines').  A per-request "
+                        "'deadline_ms' in the JSONL op overrides.  0 = "
+                        "no deadline.  Env fallback: CST_SERVE_DEADLINE_MS")
+    g.add_argument("--serve_recover", type=int, default=1,
+                   help="1 (default) = arm the self-healing scheduler "
+                        "(scripts/serve.py): garbled or failing decode "
+                        "chunks are re-run deterministically, escalating "
+                        "to an engine rebuild from the warm program "
+                        "cache, escalating to exit 124 for supervised "
+                        "restart (RESILIENCE.md 'Serving faults').  "
+                        "Trades the serving programs' buffer donation "
+                        "for a re-runnable pre-chunk state.  0 = legacy "
+                        "donated fast path, detection only")
+    g.add_argument("--serve_retry_limit",
+                   type=_nonneg_int("--serve_retry_limit",
+                                    "escalate straight to rebuild"),
+                   default=2,
+                   help="deterministic chunk re-runs (and per-request "
+                        "admission retries) before the self-healing "
+                        "scheduler escalates to an engine rebuild")
+    g.add_argument("--serve_rebuild_limit",
+                   type=_nonneg_int("--serve_rebuild_limit",
+                                    "never rebuild; fail immediately"),
+                   default=2,
+                   help="consecutive failed engine rebuilds before the "
+                        "server gives up as unrecoverable and exits 124 "
+                        "(wedge in the exit-code taxonomy) for "
+                        "supervised restart")
+    g.add_argument("--serve_step_budget_ms", type=float, default=0.0,
+                   help="soft per-chunk latency budget: a decode chunk "
+                        "slower than this marks health 'degraded' and "
+                        "bumps serve_slow_chunks — the step-progress "
+                        "wedge signal below the hard --wedge_timeout "
+                        "kill.  0 disables")
+    g.add_argument("--serve_heartbeat_file", default=None,
+                   help="scripts/serve.py: write a liveness "
+                        "heartbeat.json here (watchdog discipline: "
+                        "atomic, fsync'd) carrying the serving health "
+                        "payload — status, queue depth, recovery "
+                        "counters — once per second, plus the hard "
+                        "wedge kill when --wedge_timeout is set")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
@@ -635,10 +694,45 @@ def warn_serving_decode_chunk(ns: argparse.Namespace) -> None:
               file=sys.stderr)
 
 
+_warned_serve_deadline = False
+
+
+def warn_serve_deadline(ns: argparse.Namespace) -> None:
+    """A request deadline below ONE decode-chunk budget can never be met:
+    the scheduler's smallest unit of service is one compiled chunk over
+    the slot batch, and the largest serve bucket pays the most per chunk
+    — so with ``--serve_deadline_ms`` under ``--serve_step_budget_ms``
+    (the operator's own per-chunk latency budget) every request is
+    destined for the expired/shed path.  ONE stderr line at startup (the
+    --decode_chunk-0 warn-once pattern), not silence and not a
+    per-request nag; the server still runs, honoring the configured
+    deadline literally."""
+    global _warned_serve_deadline
+    if _warned_serve_deadline:
+        return
+    deadline = float(getattr(ns, "serve_deadline_ms", 0) or 0)
+    budget = float(getattr(ns, "serve_step_budget_ms", 0) or 0)
+    if 0 < deadline < budget:
+        _warned_serve_deadline = True
+        try:
+            from .serving.buckets import parse_buckets
+
+            largest = parse_buckets(ns.serve_buckets)[-1]
+            bucket = f"the largest serve bucket ({largest} slots)"
+        except (ValueError, AttributeError):
+            bucket = "the largest serve bucket"
+        print(f"warning: --serve_deadline_ms {deadline:g} is below one "
+              f"decode-chunk budget (--serve_step_budget_ms {budget:g}) "
+              f"for {bucket} — such a deadline can never be met; every "
+              "request will expire or be shed before completing",
+              file=sys.stderr)
+
+
 def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     ns = build_parser().parse_args(argv)
     apply_tuned_defaults(ns, argv)
     _warn_overlap_under_device_rewards(ns, argv)
     if getattr(ns, "engine", "legacy") == "serving":
         warn_serving_decode_chunk(ns)
+        warn_serve_deadline(ns)
     return ns

@@ -180,13 +180,17 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
     # observed ~1-in-3 per invocation some days, and NOT sticky — an
     # adjacent invocation in the same process is fine).  A random-init
     # model's XE loss is never exactly 0.0, so an all-zero loss curve is
-    # a reliable garble signature.  Fresh re-fetches of re-stacked arrays
-    # still read 0.0 (the zeros are device-side), so the recovery is a
-    # bounded DETERMINISTIC re-run of the whole pipeline: every input is
-    # seeded, so a clean retry returns exactly what a clean first attempt
-    # would have — a real, reproducible zero-loss regression would fail
-    # all retries and still surface.
-    if all(float(v) == 0.0 for v in np.asarray(scalars["xe_losses"])):
+    # a reliable garble signature (resilience/garble.py — the shared
+    # detector the serving engine's self-healing scheduler uses too).
+    # Fresh re-fetches of re-stacked arrays still read 0.0 (the zeros are
+    # device-side), so the recovery is a bounded DETERMINISTIC re-run of
+    # the whole pipeline: every input is seeded, so a clean retry returns
+    # exactly what a clean first attempt would have — a real,
+    # reproducible zero-loss regression would fail all retries and still
+    # surface.
+    from cst_captioning_tpu.resilience.garble import all_zero
+
+    if all_zero(scalars["xe_losses"]):
         if _attempt < 2:
             print(f"run_dp_pipeline: device scalars garbled to all-0.0 "
                   f"(native-stack caveat, RESILIENCE.md); deterministic "

@@ -18,7 +18,10 @@ in RESILIENCE.md):
   trainer honors it at the next step boundary with a verified save and a
   dedicated resumable exit code;
 - :mod:`exitcodes` — the exit-code taxonomy (ok/resumable/wedge/fatal)
-  shared by the CLIs and the stage harness.
+  shared by the CLIs and the stage harness;
+- :mod:`garble` — the native-stack device-scalar garble signatures (the
+  all-0.0 detector shared by ``parallel/dryrun.py`` and the serving
+  engine's self-healing scheduler) + the serving health-status words.
 """
 
 from .exitcodes import (
@@ -29,6 +32,7 @@ from .exitcodes import (
     describe,
 )
 from .faults import FaultPlan, FaultSpec, InjectedFault
+from .garble import GarbledChunk, all_zero, garbled_decode_slots, health_status
 from .guard import DivergenceGuard, DivergenceUnrecoverable
 from .integrity import (
     MANIFEST_NAME,
@@ -47,6 +51,10 @@ __all__ = [
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
+    "GarbledChunk",
+    "all_zero",
+    "garbled_decode_slots",
+    "health_status",
     "DivergenceGuard",
     "DivergenceUnrecoverable",
     "MANIFEST_NAME",

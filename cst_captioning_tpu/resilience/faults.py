@@ -16,27 +16,40 @@ Grammar (comma-separated specs)::
 
     kind@step=N        fire once when the trainer dispatches step N (0-based)
     kind@batch=N       fire once when the loader assembles batch N (0-based)
+    kind@req=N         fire once for the serving engine's Nth submitted
+                       request (0-based submission ordinal)
     kind@step=N*K      fire on steps N, N+1, ..., N+K-1 (K consecutive)
 
 Registered kinds and the index they key on:
 
-==============  =======  ====================================================
-kind            keys on  effect at the injection site
-==============  =======  ====================================================
-``ckpt_torn``   step     truncate a payload file of the just-committed
-                         checkpoint AFTER its manifest was written — a torn
-                         write the integrity layer must catch on restore
-``nan_grad``    step     corrupt the step's host-side inputs to NaN so the
-                         device computes a non-finite loss/gradient
-``loader_err``  batch    raise a transient OSError from the loader's feature
-                         read (the prefetch retry path must absorb it)
-``wedge``       step     block the train loop forever (the watchdog must
-                         turn this into a fast exit 124)
-``preempt``     step     deliver a REAL ``SIGTERM`` to the running process
-                         when step N is dispatched (the preemption layer
-                         must checkpoint at the next step boundary and exit
-                         with the resumable taxonomy code)
-==============  =======  ====================================================
+===============  =======  ===================================================
+kind             keys on  effect at the injection site
+===============  =======  ===================================================
+``ckpt_torn``    step     truncate a payload file of the just-committed
+                          checkpoint AFTER its manifest was written — a torn
+                          write the integrity layer must catch on restore
+``nan_grad``     step     corrupt the step's host-side inputs to NaN so the
+                          device computes a non-finite loss/gradient
+``loader_err``   batch    raise a transient OSError from the loader's feature
+                          read (the prefetch retry path must absorb it)
+``wedge``        step     block the train loop forever (the watchdog must
+                          turn this into a fast exit 124)
+``preempt``      step     deliver a REAL ``SIGTERM`` to the running process
+                          when step N is dispatched (the preemption layer
+                          must checkpoint at the next step boundary and exit
+                          with the resumable taxonomy code)
+``serve_wedge``  req      raise a transient error from the serving engine's
+                          chunk dispatch while request N is resident (the
+                          self-healing scheduler must re-run the chunk —
+                          RESILIENCE.md "Serving faults")
+``serve_garble`` req      zero request N's fetched chunk outputs — the
+                          native-stack device-scalar garble's signature
+                          (``resilience/garble.py``); the engine must detect
+                          the impossible output and re-run deterministically
+``admit_err``    req      raise a transient error from request N's admission
+                          (the engine must re-queue and retry, never drop
+                          the request silently or kill the scheduler loop)
+===============  =======  ===================================================
 
 Firing is deterministic and single-shot per (kind, index): a plan replayed
 after a rollback does not re-fire indices it already consumed, so chaos
@@ -66,10 +79,16 @@ KINDS: Dict[str, str] = {
     "loader_err": "batch",
     "wedge": "step",
     "preempt": "step",
+    # Serving failure domain (RESILIENCE.md "Serving faults"): keyed on
+    # the request's submission ordinal, threaded into serving/engine.py.
+    "serve_wedge": "req",
+    "serve_garble": "req",
+    "admit_err": "req",
 }
 
 _SPEC_RE = re.compile(
-    r"^(?P<kind>[a-z_]+)@(?P<axis>step|batch)=(?P<at>\d+)(\*(?P<times>\d+))?$"
+    r"^(?P<kind>[a-z_]+)@(?P<axis>step|batch|req)=(?P<at>\d+)"
+    r"(\*(?P<times>\d+))?$"
 )
 
 
@@ -142,8 +161,8 @@ class FaultPlan:
             if m is None:
                 raise ValueError(
                     f"bad fault spec {raw!r}; expected kind@step=N, "
-                    f"kind@batch=N, or kind@step=N*K with kind in "
-                    f"{sorted(KINDS)}")
+                    f"kind@batch=N, kind@req=N, or kind@step=N*K with "
+                    f"kind in {sorted(KINDS)}")
             kind, axis = m.group("kind"), m.group("axis")
             if kind not in KINDS:
                 raise ValueError(

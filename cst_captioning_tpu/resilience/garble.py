@@ -1,0 +1,95 @@
+"""Device-scalar garble signatures + serving health status.
+
+This environment's native stack has a documented, probabilistic defect
+(RESILIENCE.md "Environment caveat"): a compiled program's device scalars
+are occasionally garbled to exactly ``0.0`` — device-side, not fetch-side,
+and not sticky (an adjacent invocation of the same program is clean).
+PR 8 detected it ad hoc in ``parallel/dryrun.py`` by the impossible
+all-0.0 XE-loss curve; this module is that detector made shared, so the
+serving engine's self-healing scheduler and the parallel dry-run pipeline
+can never disagree on what "garbled" means.
+
+Two signatures:
+
+- :func:`all_zero` — the generic form: a non-empty batch of values that
+  are ALL exactly ``0.0``.  Useful wherever the clean computation provably
+  cannot produce an all-zero result (a random-init model's XE loss, a
+  log-softmax score row).
+- :func:`garbled_decode_slots` — the serving form: a decode chunk's
+  fetched ``(tokens, finished)`` pair is IMPOSSIBLE for a live slot when
+  the finished flag reads False but every token in the chunk is 0.  Both
+  chunk bodies (greedy and beam, ``serving/engine.py``) set ``finished``
+  the same step they emit token 0, so a row that emitted only zeros must
+  read finished — unless the fetch (or the device buffers behind it) was
+  zeroed wholesale, which is exactly the garble's shape.
+
+Detection is cheap host-side numpy on buffers the scheduler already
+fetched; nothing here touches a jitted program.  Recovery policy lives
+with the caller (``dryrun`` re-runs its seeded pipeline; the serving
+engine re-runs the chunk and escalates to an engine rebuild —
+RESILIENCE.md "Serving faults").
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+
+class GarbledChunk(RuntimeError):
+    """A decode chunk's fetched outputs carry the garble signature.
+
+    Raised by the serving engine's dispatch when recovery is armed;
+    ``slots`` names the offending slot indices for the log line.
+    """
+
+    def __init__(self, slots: List[int]):
+        super().__init__(
+            f"decode chunk garbled (impossible all-zero signature) at "
+            f"slot(s) {slots}")
+        self.slots = list(slots)
+
+
+def all_zero(values) -> bool:
+    """True when ``values`` is non-empty and every element is exactly 0.0.
+
+    The generic garble signature: use only where a clean computation
+    provably cannot be all-zero (e.g. random-init XE losses — the
+    ``parallel/dryrun.py`` detector this generalizes).
+    """
+    arr = np.asarray(values)
+    return arr.size > 0 and bool(np.all(arr == 0))
+
+
+def garbled_decode_slots(toks: np.ndarray, fin: np.ndarray,
+                         live_slots: Iterable[int]) -> List[int]:
+    """Slots whose fetched chunk outputs are impossible for a live row.
+
+    ``toks`` is the chunk's emitted tokens — ``(slots, chunk)`` greedy or
+    ``(slots, chunk, k)`` beam; ``fin`` the per-slot reduced finished mask
+    (``ops.sampling.finished_mask``); ``live_slots`` the slots holding a
+    resident at chunk entry (empty slots legitimately emit zeros forever
+    and are never checked).  A live slot with ``fin == False`` and an
+    all-zero token chunk violates the chunk-body invariant *emit 0 ⇒
+    finished that same step* — the garble signature, per slot.
+    """
+    bad = []
+    for slot in live_slots:
+        if not bool(fin[slot]) and all_zero(toks[slot]):
+            bad.append(int(slot))
+    return bad
+
+
+def health_status(*, draining: bool, recovering: bool) -> str:
+    """The serving health plane's one-word status.
+
+    ``draining`` (a preemption signal was honored; admissions closed)
+    dominates; ``recovering`` (a recovery event — retry, rebuild, fault,
+    slow chunk — inside the engine's degraded window) reads ``degraded``;
+    otherwise ``ok``.  Shared by the engine's ``health()`` and the
+    front-end ``{"op": "health"}`` response so the two can't drift.
+    """
+    if draining:
+        return "draining"
+    return "degraded" if recovering else "ok"

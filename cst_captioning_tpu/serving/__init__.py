@@ -11,11 +11,16 @@ discipline of PAPERS.md arXiv 2603.09555):
 - ``engine.py``   — the step-driven scheduler: bucketed batch slots,
   one-encoder-pass admission that writes encoder outputs + decoder carry
   into the slot in place, a per-row finished predicate
-  (``ops.sampling.finished_mask``) that frees a slot mid-flight, and
-  bit-identical captions vs the offline ``eval.py`` decode (test-pinned);
+  (``ops.sampling.finished_mask``) that frees a slot mid-flight,
+  bit-identical captions vs the offline ``eval.py`` decode (test-pinned),
+  request deadlines with mid-flight TTL eviction, and a chaos-drilled
+  self-healing ladder (deterministic chunk re-run -> ProgramCache-warm
+  engine rebuild -> exit taxonomy — RESILIENCE.md "Serving faults");
 - ``server.py``   — stdin/JSONL + optional localhost-socket front end with
-  bounded-queue backpressure and graceful SIGTERM drain through the
-  ``resilience`` preemption/exit-code taxonomy;
+  bounded-queue backpressure, hardened per-line intake, the
+  ``{"op": "health"}`` ok|degraded|draining query, and graceful SIGTERM
+  drain (second signal = hard stop) through the ``resilience``
+  preemption/exit-code taxonomy;
 - ``bench.py``    — the open-loop Poisson serving probe (seeded,
   deterministic arrivals; p50/p99 latency + captions/s) that joins the
   repo bench's JSON line and cache.

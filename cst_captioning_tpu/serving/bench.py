@@ -114,4 +114,8 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "beam_size": engine.beam_size,
         "decode_chunk": engine.chunk,
         "max_len": int(max_len),
+        # Fault-tolerance audit (all 0 on a healthy fault-free probe;
+        # scripts/serve_report.py renders them and FAILS on a
+        # rebuild-recompile violation — RESILIENCE.md "Serving faults").
+        **engine.recovery_counters(),
     }

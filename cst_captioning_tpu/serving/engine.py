@@ -30,6 +30,31 @@ with the PR-6 ``decode_kernel`` routing, the same greedy/beam step bodies
 Programs compile once per bucket through ``buckets.ProgramCache``; under
 steady load the build counter must not move (SERVING.md).
 
+Fault tolerance (RESILIENCE.md "Serving faults"):
+
+- **Deadlines.**  A request may carry a deadline (engine default or
+  per-request override).  An expired resident is evicted mid-flight —
+  its slot frees through the same recycling an EOS uses, the caller gets
+  an ``expired`` drop record — and a queued request whose deadline has
+  lapsed, or cannot cover even ONE chunk at the current p99 chunk
+  latency, is dropped instead of admitted.
+- **Self-healing** (``recover=True``): a chunk dispatch that raises
+  (transient device/transport error, or the injected ``serve_wedge``) or
+  returns the device-scalar garble signature (``resilience/garble.py``;
+  injected as ``serve_garble``) is retried as a bounded DETERMINISTIC
+  re-run — recovery mode compiles its programs WITHOUT buffer donation,
+  so the pre-chunk state survives the failed dispatch and a clean retry
+  is bit-identical to a clean first attempt.  After ``retry_limit``
+  failures the engine REBUILDS: fresh slot state, residents re-admitted
+  from their requests (their already-emitted tokens persist host-side as
+  the replay-verification prefix), all through the warm ``ProgramCache``
+  — a rebuild that compiles anything bumps ``serve_rebuild_recompiles``,
+  the contract violation counter.  ``rebuild_limit`` consecutive
+  failed rebuilds raise :class:`ServingUnrecoverable`, which the front
+  end maps onto the exit-code taxonomy (124) for supervised restart.
+- **Admission errors** (injected as ``admit_err``) re-queue the request
+  at the head and retry next step, bounded per request.
+
 Threading: the engine is single-owner — ``submit``/``step``/``drain``
 must be called from one thread (the server's scheduler loop); front-end
 reader threads hand lines to that loop, never to the engine directly.
@@ -37,6 +62,7 @@ reader threads hand lines to that loop, never to the engine directly.
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -49,13 +75,31 @@ import jax.numpy as jnp
 
 from ..ops.beam import NEG_INF, _expand_to_beams, _reorder_beams
 from ..ops.sampling import finished_mask, make_decode_step
+from ..resilience.faults import InjectedFault
+from ..resilience.garble import GarbledChunk, garbled_decode_slots, \
+    health_status
 from ..telemetry.spans import trace_span
 from .buckets import DEFAULT_BUCKETS, ProgramCache, config_key, pick_bucket
+
+log = logging.getLogger("cst_captioning_tpu.serving.engine")
 
 #: Counters the engine owns (declared at 0 so snapshots distinguish
 #: "armed, nothing happened" from "feature absent" — registry.declare).
 COUNTERS = ("serve_requests", "serve_admitted", "serve_completed",
-            "serve_shed", "serve_rejected_drain", "serve_compiles")
+            "serve_shed", "serve_rejected_drain", "serve_compiles",
+            # Fault-tolerance audit trail (RESILIENCE.md "Serving faults").
+            "serve_expired", "serve_deadline_shed", "serve_chunk_retries",
+            "serve_rebuilds", "serve_rebuild_recompiles",
+            "serve_garble_detected", "serve_wedge_detected",
+            "serve_admit_errors", "serve_replay_divergence",
+            "serve_slow_chunks")
+
+
+class ServingUnrecoverable(RuntimeError):
+    """The self-healing ladder is exhausted: retries failed, rebuilds
+    failed.  The front end maps this onto ``exitcodes.EXIT_WEDGE`` (124)
+    so a ``scale_chain``-style supervisor restarts the server once the
+    environment heals — in-process recovery has proven impossible."""
 
 
 @dataclass
@@ -66,6 +110,11 @@ class Request:
     feats: List[np.ndarray]
     arrival: float = 0.0
     meta: Optional[dict] = None
+    #: Submission ordinal (0-based) — the ``@req=N`` fault-plan axis.
+    index: int = -1
+    #: Absolute engine-clock deadline; None = no TTL.
+    deadline: Optional[float] = None
+    admit_attempts: int = 0
 
 
 @dataclass
@@ -83,6 +132,22 @@ class Completion:
 
 
 @dataclass
+class Dropped:
+    """A request the scheduler gave up on (never a silent loss).
+
+    ``reason`` is ``"expired"`` (deadline lapsed — ``where`` says whether
+    it was still queued or already resident), ``"deadline_shed"`` (queued,
+    deadline cannot cover one p99 chunk — conservative by design), or
+    ``"admit_failed"`` (admission errored past its retry bound)."""
+
+    request_id: Any
+    reason: str
+    where: str
+    deadline: Optional[float] = None
+    meta: Optional[dict] = None
+
+
+@dataclass
 class _Resident:
     request: Request
     slot: int
@@ -90,6 +155,9 @@ class _Resident:
     steps: int = 0
     toks: List[np.ndarray] = field(default_factory=list)
     pars: List[np.ndarray] = field(default_factory=list)
+    #: Tokens emitted before an engine rebuild — the persisted prefix the
+    #: deterministic replay is verified against at harvest.
+    prefix: Optional[np.ndarray] = None
 
 
 class ServingEngine:
@@ -102,6 +170,16 @@ class ServingEngine:
     ``queue_limit`` bounds the submit queue (0/None = unbounded, the
     offline-parity mode); ``clock`` is injectable for deterministic
     scheduler tests.
+
+    Fault-tolerance knobs: ``deadline_ms`` is the default request TTL
+    (0 = none; a per-request ``deadline_ms`` in ``submit`` overrides);
+    ``fault_plan`` threads the chaos plan's ``@req=N`` kinds in;
+    ``recover`` arms the self-healing ladder (retry -> rebuild -> raise;
+    it trades the chunk/admit programs' buffer donation for a re-runnable
+    pre-chunk state); ``retry_limit``/``rebuild_limit`` bound it;
+    ``step_budget_ms`` flags slow chunks (0 = off) into the health plane;
+    ``degraded_window_s`` is how long after a recovery event ``health()``
+    reports ``degraded``.
     """
 
     def __init__(self, model, variables, feat_shapes: Sequence[Tuple[int, int]],
@@ -109,6 +187,13 @@ class ServingEngine:
                  decode_chunk: int = 8,
                  bucket_sizes: Sequence[int] = DEFAULT_BUCKETS,
                  queue_limit: Optional[int] = 64,
+                 deadline_ms: float = 0.0,
+                 fault_plan=None,
+                 recover: bool = False,
+                 retry_limit: int = 2,
+                 rebuild_limit: int = 2,
+                 step_budget_ms: float = 0.0,
+                 degraded_window_s: float = 60.0,
                  registry=None, tracer=None,
                  clock: Callable[[], float] = time.monotonic):
         if getattr(model, "decoder_type", "lstm") != "lstm":
@@ -133,6 +218,13 @@ class ServingEngine:
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"bad bucket_sizes {bucket_sizes!r}")
         self.queue_limit = int(queue_limit or 0)
+        self.deadline_ms = float(deadline_ms or 0.0)
+        self._plan = fault_plan
+        self.recover = bool(recover)
+        self.retry_limit = max(0, int(retry_limit))
+        self.rebuild_limit = max(0, int(rebuild_limit))
+        self.step_budget_ms = float(step_budget_ms or 0.0)
+        self.degraded_window_s = float(degraded_window_s)
         self._registry = registry
         self._tracer = tracer
         self.clock = clock
@@ -143,10 +235,22 @@ class ServingEngine:
         self._slots_n = 0
         self._dev: Optional[Dict[str, Any]] = None
         self._latencies: deque = deque(maxlen=1024)
+        self._chunk_wall: deque = deque(maxlen=128)
+        self._dropped: List[Dropped] = []
         self._submitted = 0
         self._completed = 0
         self._shed = 0
         self._rejected = 0
+        self._expired = 0
+        self._deadline_shed = 0
+        self._chunk_retries = 0
+        self._rebuilds = 0
+        self._rebuild_recompiles = 0
+        self._garbles = 0
+        self._wedges = 0
+        self._admit_errors = 0
+        self._replay_divergence = 0
+        self._last_recovery_at: Optional[float] = None
         self._avals = self._request_avals()
         for leaf in jax.tree_util.tree_leaves(self._avals[3]):
             if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != self.beam_size:
@@ -178,6 +282,11 @@ class ServingEngine:
         return jax.eval_shape(enc, self._variables, feats)
 
     def _config_key(self, slots: int, kind: str) -> tuple:
+        # Recovery mode compiles the SAME math without buffer donation
+        # (the pre-chunk state must survive a failed dispatch), so the two
+        # variants could compile differently and must never share a key.
+        if self.recover:
+            kind = kind + "-recover"
         return config_key(
             kind=kind, bucket=slots, beam_size=self.beam_size,
             max_len=self.max_len, decode_chunk=self.chunk,
@@ -187,6 +296,12 @@ class ServingEngine:
             feat_shapes=self._feat_shapes,
             dtype=str(getattr(self.model, "dtype", jnp.float32)),
         )
+
+    def _donate(self) -> tuple:
+        """Donation spec for the state argument: donated on the legacy
+        fast path, kept alive under ``recover`` so a chunk/admit that
+        raises or garbles leaves a valid pre-dispatch state to re-run."""
+        return () if self.recover else (1,)
 
     def _programs(self, slots: int) -> Dict[str, Callable]:
         build = (self._build_beam_programs if self.beam_size > 1
@@ -222,7 +337,7 @@ class ServingEngine:
     def _build_admit(self, slots: int) -> Callable:
         """One compiled program: encode one request (batch 1), expand to
         beam rows, write encodings + fresh carry + reset per-slot columns
-        into ``row``'s rows of the donated state."""
+        into ``row``'s rows of the (legacy path: donated) state."""
         k = self.beam_size
         max_len = self.max_len
         model = self.model
@@ -269,7 +384,7 @@ class ServingEngine:
                                       jnp.zeros((k,), jnp.int32))
             return new
 
-        return jax.jit(fn, donate_argnums=(1,))
+        return jax.jit(fn, donate_argnums=self._donate())
 
     def _build_greedy_programs(self, slots: int) -> Dict[str, Callable]:
         chunk = self.chunk
@@ -304,7 +419,7 @@ class ServingEngine:
             return new, toks.T                      # (slots, chunk)
 
         return {"admit": self._build_admit(slots),
-                "chunk": jax.jit(chunk_fn, donate_argnums=(1,))}
+                "chunk": jax.jit(chunk_fn, donate_argnums=self._donate())}
 
     def _build_beam_programs(self, slots: int) -> Dict[str, Callable]:
         chunk = self.chunk
@@ -353,16 +468,20 @@ class ServingEngine:
             return new, (toks.transpose(1, 0, 2), pars.transpose(1, 0, 2))
 
         return {"admit": self._build_admit(slots),
-                "chunk": jax.jit(chunk_fn, donate_argnums=(1,))}
+                "chunk": jax.jit(chunk_fn, donate_argnums=self._donate())}
 
     # -- queue -------------------------------------------------------------
 
     def submit(self, request_id, feats: Sequence[np.ndarray],
-               meta: Optional[dict] = None) -> bool:
+               meta: Optional[dict] = None,
+               deadline_ms: Optional[float] = None) -> bool:
         """Queue one request.  Returns False (sheds) when the bounded
         queue is full — the engine's backpressure signal; the front end
-        turns it into an explicit reject response."""
+        turns it into an explicit reject response.  ``deadline_ms``
+        overrides the engine's default TTL for this request (None = use
+        the default; 0 = explicitly no deadline)."""
         self._submitted += 1
+        index = self._submitted - 1        # submission ordinal (@req=N)
         self._inc("serve_requests")
         feats = [np.asarray(f, np.float32) for f in feats]
         shapes = tuple(f.shape for f in feats)
@@ -375,8 +494,11 @@ class ServingEngine:
             self._inc("serve_shed")
             self._update_gauges()
             return False
+        ttl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        deadline = (self.clock() + ttl / 1e3) if ttl and ttl > 0 else None
         self._queue.append(Request(request_id, feats,
-                                   arrival=self.clock(), meta=meta))
+                                   arrival=self.clock(), meta=meta,
+                                   index=index, deadline=deadline))
         self._update_gauges()
         return True
 
@@ -387,6 +509,75 @@ class ServingEngine:
     @property
     def resident_count(self) -> int:
         return sum(1 for r in self._residents if r is not None)
+
+    def resident_requests(self) -> List[Request]:
+        """The requests currently holding slots — after an aborted drain,
+        these are the abandoned ones the front end still owes an answer."""
+        return [r.request for r in self._residents if r is not None]
+
+    def pop_dropped(self) -> List[Dropped]:
+        """Drain the drop records (expired / deadline-shed / admit-failed)
+        accumulated since the last call; the front end answers each with
+        an explicit per-request error response."""
+        out, self._dropped = self._dropped, []
+        return out
+
+    # -- deadlines ---------------------------------------------------------
+
+    def _drop(self, req: Request, reason: str, where: str) -> None:
+        self._dropped.append(Dropped(req.request_id, reason, where,
+                                     deadline=req.deadline, meta=req.meta))
+        if reason == "expired":
+            self._expired += 1
+            self._inc("serve_expired")
+        elif reason == "deadline_shed":
+            self._deadline_shed += 1
+            self._inc("serve_deadline_shed")
+
+    def _min_service_s(self) -> Optional[float]:
+        """One p99 chunk's worth of wall time — the shed floor: a queued
+        request needs at least one chunk, costed at the tail latency so
+        the estimate is deliberately CONSERVATIVE (a latency hiccup in
+        the 128-chunk window sheds early for a while rather than
+        admitting work likely to expire mid-flight and waste decode
+        steps).  None until enough samples exist to call a percentile
+        honest."""
+        if len(self._chunk_wall) < 4:
+            return None
+        return float(np.percentile(np.asarray(self._chunk_wall), 99))
+
+    def _expire_residents(self, now: float) -> None:
+        """TTL eviction mid-flight: a resident past its deadline frees
+        its slot immediately (the next admission overwrites the rows, the
+        same in-place write an EOS-freed slot gets)."""
+        for slot, res in enumerate(self._residents):
+            if res is None or res.request.deadline is None:
+                continue
+            if now >= res.request.deadline:
+                self._residents[slot] = None
+                self._drop(res.request, "expired", "resident")
+                log.info("request %r expired mid-flight (slot %d, "
+                         "%d decode steps paid)", res.request.request_id,
+                         slot, res.steps)
+
+    def _next_admittable(self) -> Optional[Request]:
+        """Pop the next queued request worth admitting: drop outright-
+        expired ones and shed those whose remaining deadline cannot cover
+        even one chunk at the current p99 chunk latency (conservative by
+        design — see ``_min_service_s``)."""
+        now = self.clock()
+        min_s = self._min_service_s()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.deadline is not None:
+                if now >= req.deadline:
+                    self._drop(req, "expired", "queued")
+                    continue
+                if min_s is not None and (req.deadline - now) < min_s:
+                    self._drop(req, "deadline_shed", "queued")
+                    continue
+            return req
+        return None
 
     # -- scheduling --------------------------------------------------------
 
@@ -438,25 +629,196 @@ class ServingEngine:
             return
         programs = self._programs(self._slots_n)
         for slot, res in enumerate(self._residents):
-            if res is not None or not self._queue:
+            if res is not None:
                 continue
-            req = self._queue.popleft()
-            with trace_span(self._tracer, "serve.admit"):
-                t0 = time.perf_counter()
-                feats = [jnp.asarray(f[None]) for f in req.feats]
-                self._dev = programs["admit"](self._variables, self._dev,
-                                              feats, slot)
-                admit_ms = (time.perf_counter() - t0) * 1e3
+            req = self._next_admittable()
+            if req is None:
+                break
+            try:
+                if self._plan is not None and \
+                        self._plan.fire("admit_err", req.index):
+                    raise InjectedFault(
+                        f"injected admit_err at request {req.index}")
+                with trace_span(self._tracer, "serve.admit"):
+                    t0 = time.perf_counter()
+                    feats = [jnp.asarray(f[None]) for f in req.feats]
+                    self._dev = programs["admit"](self._variables, self._dev,
+                                                  feats, slot)
+                    admit_ms = (time.perf_counter() - t0) * 1e3
+            except Exception as e:
+                # A transient admission failure must neither kill the
+                # scheduler loop nor silently drop the request.  With the
+                # state donated (legacy path) a REAL mid-program failure
+                # leaves it unusable, so only injected faults (raised
+                # before the dispatch) are absorbed there.
+                if not self.recover and not isinstance(e, InjectedFault):
+                    raise
+                self._inc("serve_admit_errors")
+                self._admit_errors += 1
+                self._note_recovery_event()
+                req.admit_attempts += 1
+                if req.admit_attempts > self.retry_limit:
+                    self._drop(req, "admit_failed", "admit")
+                    log.warning("admission of request %r failed %d times "
+                                "(%s); dropping", req.request_id,
+                                req.admit_attempts, e)
+                else:
+                    self._queue.appendleft(req)  # FIFO head: retried next
+                    log.warning("admission of request %r failed (%s); "
+                                "retry %d/%d at the next scheduler step",
+                                req.request_id, e, req.admit_attempts,
+                                self.retry_limit)
+                break
             self._residents[slot] = _Resident(req, slot,
                                               admit_at=self.clock())
             self._inc("serve_admitted")
             self._observe("serve_admit_ms", admit_ms)
 
+    def _dispatch_chunk(self, programs) -> Tuple[np.ndarray, np.ndarray,
+                                                 Optional[np.ndarray]]:
+        """Run ONE chunk program and fetch (fin, toks, pars), with the
+        fault hooks and the garble detector in the fetch path.  Commits
+        ``self._dev`` only on a clean dispatch, so under ``recover`` a
+        raise leaves the pre-chunk state valid for a deterministic
+        re-run."""
+        k = self.beam_size
+        live = [(slot, res) for slot, res in enumerate(self._residents)
+                if res is not None]
+        if self._plan is not None:
+            for slot, res in live:
+                if self._plan.fire("serve_wedge", res.request.index):
+                    raise InjectedFault(
+                        f"injected serve_wedge while request "
+                        f"{res.request.index} resident in slot {slot}")
+        with trace_span(self._tracer, "serve.decode_chunk"):
+            t0 = time.perf_counter()
+            new_dev, extras = programs["chunk"](self._variables, self._dev)
+            # The per-row predicate — the finished_mask helper the
+            # early-exit chunks share — reduced on device, fetched once.
+            fin = np.asarray(jax.device_get(
+                finished_mask(new_dev["finished"])))
+            if k == 1:
+                toks = np.asarray(jax.device_get(extras))
+                pars = None
+            else:
+                toks, pars = (np.asarray(x) for x in jax.device_get(extras))
+            chunk_s = time.perf_counter() - t0
+        if self._plan is not None:
+            fired = [slot for slot, res in live
+                     if self._plan.fire("serve_garble", res.request.index)]
+            if fired:
+                # The real event zeroes the device buffers wholesale;
+                # zeroing the fetch reproduces exactly what the scheduler
+                # would read (parallel/dryrun.py's caveat).  device_get
+                # views are read-only, hence the copies.
+                toks, fin = np.array(toks), np.array(fin)
+                for slot in fired:
+                    toks[slot] = 0
+                    fin[slot] = False
+        bad = garbled_decode_slots(toks, fin, [s for s, _ in live])
+        if bad:
+            self._inc("serve_garble_detected", len(bad))
+            self._garbles += len(bad)
+            if self.recover:
+                raise GarbledChunk(bad)
+            self._note_recovery_event()
+            log.warning("garbled decode chunk (slots %s) with recovery "
+                        "disabled; reporting as computed", bad)
+        self._dev = new_dev
+        self._chunk_wall.append(chunk_s)
+        chunk_ms = chunk_s * 1e3
+        self._observe("serve_decode_step_ms", chunk_ms / self.chunk)
+        if self.step_budget_ms and chunk_ms > self.step_budget_ms:
+            self._inc("serve_slow_chunks")
+            self._note_recovery_event()
+            log.warning("decode chunk took %.1fms (> %.1fms budget) — "
+                        "soft wedge signal", chunk_ms, self.step_budget_ms)
+        return fin, toks, pars
+
+    def _run_chunk_recovered(self, programs):
+        """The self-healing ladder: bounded deterministic chunk re-runs,
+        escalating to an engine rebuild, escalating to
+        :class:`ServingUnrecoverable` (RESILIENCE.md recovery table)."""
+        attempts = 0
+        rebuilds = 0
+        while True:
+            try:
+                return self._dispatch_chunk(programs)
+            except (InjectedFault, GarbledChunk, RuntimeError, OSError) as e:
+                if isinstance(e, ServingUnrecoverable):
+                    raise
+                if not isinstance(e, GarbledChunk):
+                    # Wedge-class: the dispatch itself failed (injected
+                    # serve_wedge, or a real transport/runtime error).
+                    # Counted BEFORE the recover gate so detection is
+                    # auditable even on the fail-fast path.
+                    self._inc("serve_wedge_detected")
+                    self._wedges += 1
+                    self._note_recovery_event()
+                if not self.recover:
+                    raise
+                self._note_recovery_event()
+                attempts += 1
+                self._inc("serve_chunk_retries")
+                self._chunk_retries += 1
+                log.warning("serving chunk failed (%s); deterministic "
+                            "re-run %d/%d", e, attempts,
+                            max(self.retry_limit, 1))
+                if attempts <= self.retry_limit:
+                    continue
+                rebuilds += 1
+                if rebuilds > self.rebuild_limit:
+                    raise ServingUnrecoverable(
+                        f"serving chunk failed through {attempts} "
+                        f"re-run(s) and {rebuilds - 1} rebuild(s); last "
+                        f"error: {e}") from e
+                self._rebuild(programs)
+                attempts = 0
+
+    def _rebuild(self, programs) -> None:
+        """Escalated recovery: fresh slot state, residents re-admitted
+        from their persisted requests — entirely through the warm
+        ``ProgramCache`` (a rebuild must compile NOTHING; any build here
+        bumps the ``serve_rebuild_recompiles`` violation counter).  The
+        already-emitted tokens move to ``prefix``: the deterministic
+        replay re-derives them and harvest verifies the match."""
+        builds0 = self._cache.builds
+        self._rebuilds += 1
+        self._inc("serve_rebuilds")
+        log.warning("serving engine rebuild #%d: re-initializing %d slots, "
+                    "re-admitting %d resident(s) from persisted requests",
+                    self._rebuilds, self._slots_n, self.resident_count)
+        self._dev = self._init_state(self._slots_n)
+        for slot, res in enumerate(self._residents):
+            if res is None:
+                continue
+            if res.toks:
+                prior = np.concatenate(res.toks, axis=0)
+                res.prefix = (prior if res.prefix is None
+                              else np.concatenate([res.prefix, prior],
+                                                  axis=0))
+            res.toks, res.pars, res.steps = [], [], 0
+            feats = [jnp.asarray(f[None]) for f in res.request.feats]
+            self._dev = programs["admit"](self._variables, self._dev,
+                                          feats, slot)
+        delta = self._cache.builds - builds0
+        if delta:
+            self._rebuild_recompiles += delta
+            self._inc("serve_rebuild_recompiles", delta)
+            log.error("engine rebuild compiled %d new program(s) — the "
+                      "compile-once contract is violated (SERVING.md "
+                      "'Bucket policy')", delta)
+        self._note_recovery_event()
+
     def step(self) -> List[Completion]:
-        """One scheduler step: fill free slots from the queue, run ONE
-        compiled chunk over the slot batch, harvest every row whose
-        per-row finished mask went True (freeing its slot), refill.
-        Returns the completions harvested this step (possibly [])."""
+        """One scheduler step: expire/evict past-deadline work, fill free
+        slots from the queue, run ONE compiled chunk over the slot batch
+        (through the self-healing ladder when ``recover`` is armed),
+        harvest every row whose per-row finished mask went True (freeing
+        its slot), expire again, refill.  Returns the completions
+        harvested this step (possibly []); drop records accumulate for
+        ``pop_dropped``."""
+        self._expire_residents(self.clock())
         self._ensure_bucket()
         self._admit_pending()
         done: List[Completion] = []
@@ -465,20 +827,7 @@ class ServingEngine:
             return done
         k = self.beam_size
         programs = self._programs(self._slots_n)
-        with trace_span(self._tracer, "serve.decode_chunk"):
-            t0 = time.perf_counter()
-            self._dev, extras = programs["chunk"](self._variables, self._dev)
-            # The per-row predicate — the finished_mask helper the
-            # early-exit chunks share — reduced on device, fetched once.
-            fin = np.asarray(jax.device_get(
-                finished_mask(self._dev["finished"])))
-            if k == 1:
-                toks = np.asarray(jax.device_get(extras))
-                pars = None
-            else:
-                toks, pars = (np.asarray(x) for x in jax.device_get(extras))
-            chunk_ms = (time.perf_counter() - t0) * 1e3
-        self._observe("serve_decode_step_ms", chunk_ms / self.chunk)
+        fin, toks, pars = self._run_chunk_recovered(programs)
         scores_h = lengths_h = None
         for slot, res in enumerate(self._residents):
             if res is None:
@@ -493,7 +842,9 @@ class ServingEngine:
                     lengths_h = np.asarray(
                         jax.device_get(self._dev["lengths"]))
                 done.append(self._harvest(slot, scores_h, lengths_h))
-        # Freed slots admit the next queued videos before the next chunk.
+        # Deadline sweep after the chunk, then freed slots admit the next
+        # queued videos — both before the next chunk.
+        self._expire_residents(self.clock())
         self._admit_pending()
         self._update_gauges()
         return done
@@ -502,12 +853,24 @@ class ServingEngine:
         res = self._residents[slot]
         self._residents[slot] = None
         max_len = self.max_len
+        all_toks = np.concatenate(res.toks, axis=0)
+        if res.prefix is not None:
+            # Replay-verification: a post-rebuild re-decode is the same
+            # deterministic program on the same inputs, so the re-emitted
+            # tokens must reproduce the persisted prefix bit for bit.
+            n = min(len(res.prefix), len(all_toks))
+            if not np.array_equal(all_toks[:n], res.prefix[:n]):
+                self._inc("serve_replay_divergence")
+                self._replay_divergence += 1
+                log.warning("request %r: post-rebuild replay diverged "
+                            "from its persisted prefix (slot %d)",
+                            res.request.request_id, slot)
         if self.beam_size == 1:
-            hist = np.concatenate(res.toks)[:max_len]
+            hist = all_toks[:max_len]
             row = np.zeros((max_len,), np.int32)
             row[:hist.shape[0]] = hist
         else:
-            toks = np.concatenate(res.toks, axis=0)[:max_len]    # (T, k)
+            toks = all_toks[:max_len]                            # (T, k)
             pars = np.concatenate(res.pars, axis=0)[:max_len]
             row = _backtrack_best(toks, pars, scores_h[slot],
                                   lengths_h[slot], max_len,
@@ -522,14 +885,20 @@ class ServingEngine:
         self._inc("serve_completed")
         self._latencies.append(comp.latency_s)
         self._observe("serve_request_latency_ms", comp.latency_s * 1e3)
+        if res.request.deadline is not None:
+            self._observe("serve_deadline_slack_ms",
+                          (res.request.deadline - now) * 1e3)
         return comp
 
-    def drain(self) -> Tuple[List[Completion], List[Request]]:
+    def drain(self, abort: Optional[Callable[[], bool]] = None
+              ) -> Tuple[List[Completion], List[Request]]:
         """Graceful shutdown: reject everything still queued, run the
         resident rows to completion with admissions closed, return
         (completions, rejected requests).  The SIGTERM contract
         (SERVING.md 'Drain'); the caller maps it onto the resilience
-        exit-code taxonomy."""
+        exit-code taxonomy.  ``abort`` is polled between steps: True
+        stops the drain immediately (the double-SIGTERM hard stop) with
+        residents abandoned."""
         rejected = list(self._queue)
         self._queue.clear()
         if rejected:
@@ -537,6 +906,10 @@ class ServingEngine:
             self._inc("serve_rejected_drain", len(rejected))
         done: List[Completion] = []
         while any(r is not None for r in self._residents):
+            if abort is not None and abort():
+                log.warning("drain aborted with %d resident(s) unfinished",
+                            self.resident_count)
+                break
             done.extend(self.step())
         self._update_gauges()
         return done, rejected
@@ -550,7 +923,7 @@ class ServingEngine:
             done.extend(self.step())
         return done
 
-    # -- warmup / stats ----------------------------------------------------
+    # -- warmup / stats / health -------------------------------------------
 
     def warm(self) -> Dict[str, Any]:
         """Build AND execute admit+chunk for EVERY bucket on throwaway
@@ -586,9 +959,51 @@ class ServingEngine:
             "latency_p50_ms": pct(50),
             "latency_p99_ms": pct(99),
             "latency_mean_ms": float(lat.mean()) if lat.size else None,
+            # Fault-tolerance audit (host mirrors of the registry
+            # counters, so stats are complete registry-less too).
+            **self.recovery_counters(),
+        }
+
+    def recovery_counters(self) -> Dict[str, int]:
+        """The ONE definition of the recovery audit view — ``stats()``,
+        ``health()``, and the serving bench probe all render exactly this
+        dict, so a counter added here reaches every surface at once."""
+        return {
+            "expired": self._expired,
+            "deadline_shed": self._deadline_shed,
+            "chunk_retries": self._chunk_retries,
+            "rebuilds": self._rebuilds,
+            "rebuild_recompiles": self._rebuild_recompiles,
+            "garble_detected": self._garbles,
+            "wedge_detected": self._wedges,
+            "admit_errors": self._admit_errors,
+            "replay_divergence": self._replay_divergence,
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """The health plane's view: ``ok`` | ``degraded`` (a recovery
+        event — retry, rebuild, injected fault, slow chunk — happened
+        within ``degraded_window_s``) plus queue depth and the recovery
+        counters.  Host state only: safe to call from the watchdog's
+        heartbeat payload while the scheduler may be wedged."""
+        now = self.clock()
+        recovering = (self._last_recovery_at is not None
+                      and (now - self._last_recovery_at)
+                      < self.degraded_window_s)
+        return {
+            "status": health_status(draining=False, recovering=recovering),
+            "queue_depth": len(self._queue),
+            "residents": self.resident_count,
+            "slots": self._slots_n,
+            "completed": self._completed,
+            "recovery": self.recovery_counters(),
+            "compiles": self._cache.builds,
         }
 
     # -- telemetry ---------------------------------------------------------
+
+    def _note_recovery_event(self) -> None:
+        self._last_recovery_at = self.clock()
 
     def _inc(self, name: str, n: float = 1) -> None:
         if self._registry is not None:

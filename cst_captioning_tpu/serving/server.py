@@ -3,23 +3,40 @@
 Protocol (one JSON object per line, either direction):
 
   request:   {"id": <any>, "video_id": "<key>"}
+             optional: "op": "caption" (default) | "health",
+                       "deadline_ms": <per-request TTL override>
   response:  {"id", "video_id", "caption", "latency_ms", "decode_steps"}
+  health:    {"op": "health", "status": "ok"|"degraded"|"draining",
+              "queue_depth", "residents", "recovery": {...}}
   reject:    {"id", "error": "shed" | "bad_request" | "unknown_video"
-                            | "rejected_draining", ...}
+                            | "unknown_op" | "rejected_draining"
+                            | "expired" | "admit_failed", ...}
 
 Scheduling model: reader threads (stdin, or one per socket connection)
 only parse lines into a thread-safe inbox; the single scheduler loop owns
 the engine — submit, step, respond.  Backpressure is explicit: when the
 engine's bounded queue sheds a request the client gets ``"error": "shed"``
-immediately instead of silently growing latency.
+immediately instead of silently growing latency.  Intake is hardened: a
+malformed line, an unknown ``op``, or any per-line handling error yields
+a per-line ``error`` response and a ``serve_bad_lines`` counter bump —
+one bad client line must never kill the scheduler loop.
 
 Shutdown contract (SERVING.md "Drain"): a SIGTERM/SIGINT (via the shared
 ``resilience.preemption.PreemptionHandler``) closes admissions, DRAINS
 the in-flight residents to completion, answers everything still queued
 with ``rejected_draining``, and exits ``exitcodes.EXIT_PREEMPTED`` (75) —
 the same resumable classification the training loop uses, so a fleet
-harness treats a drained server exactly like a preempted trainer.
-Stdin EOF is the natural end: finish everything, exit 0.
+harness treats a drained server exactly like a preempted trainer.  A
+SECOND signal during the drain is the hard stop: the drain aborts,
+unfinished residents are answered ``rejected_draining``, and the exit is
+``exitcodes.EXIT_SIGTERM`` (143, sigterm_unwind — still resumable in the
+taxonomy, but the lost in-flight work is honest).  Stdin EOF is the
+natural end: finish everything, exit 0.
+
+Liveness: with a ``watchdog`` attached (``utils/watchdog.ProgressWatchdog``
+— the serving ``heartbeat.json``), the scheduler loop beats it once per
+iteration; a loop wedged inside a dead transport stops beating and the
+watchdog exits 124 through the same taxonomy.
 """
 
 from __future__ import annotations
@@ -34,8 +51,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED
-from .engine import Completion, ServingEngine
+from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED, EXIT_SIGTERM
+from ..resilience.garble import health_status
+from .engine import Completion, Dropped, ServingEngine
 
 
 class CaptionServer:
@@ -44,20 +62,29 @@ class CaptionServer:
     ``feats_for(video_id)`` -> per-modality feature list (or None for an
     unknown id) — the deployment decides where features come from (h5
     lookup, upstream extractor, demo table).  ``handler`` is anything with
-    a ``requested`` bool (the preemption handler, or a test stub).
+    ``requested`` (bool) and ``signal_count`` (int) attributes — the
+    preemption handler, or a test stub.  ``watchdog`` (optional) is
+    beaten once per scheduler iteration; ``registry`` (optional) counts
+    intake errors and health queries.
     """
 
     def __init__(self, engine: ServingEngine, vocab, feats_for,
-                 *, handler=None, out=None, idle_sleep: float = 0.002):
+                 *, handler=None, out=None, idle_sleep: float = 0.002,
+                 watchdog=None, registry=None):
         self.engine = engine
         self.vocab = vocab
         self.feats_for = feats_for
         self.handler = handler
         self.out = out if out is not None else sys.stdout
         self.idle_sleep = idle_sleep
+        self.watchdog = watchdog
+        self.registry = registry
+        if registry is not None:
+            registry.declare("serve_bad_lines", "serve_health_queries")
         self._inbox: "queue.Queue" = queue.Queue()
         self._eof = threading.Event()
         self._write_lock = threading.Lock()
+        self._draining = False
 
     # -- responses ---------------------------------------------------------
 
@@ -76,32 +103,114 @@ class CaptionServer:
             "decode_steps": int(comp.decode_steps),
         })
 
+    def _respond_dropped(self, drop: Dropped) -> None:
+        meta = drop.meta or {}
+        respond = meta.get("respond", self._stdout_respond)
+        error = ("admit_failed" if drop.reason == "admit_failed"
+                 else "expired")
+        obj = {"id": meta.get("id"), "video_id": meta.get("video_id"),
+               "error": error}
+        if drop.reason == "expired":
+            obj["where"] = drop.where              # "queued" | "resident"
+        elif drop.reason == "deadline_shed":
+            obj["error"] = "expired"
+            obj["where"] = "queued"
+            obj["why"] = "deadline_unmeetable"
+        self._write(respond, obj)
+
+    def _respond_dropped_all(self) -> bool:
+        drops = self.engine.pop_dropped()
+        for drop in drops:
+            self._respond_dropped(drop)
+        return bool(drops)
+
     def _stdout_respond(self, line: str) -> None:
         self.out.write(line + "\n")
         self.out.flush()
 
+    def _count_bad_line(self) -> None:
+        if self.registry is not None:
+            self.registry.inc("serve_bad_lines")
+
+    # -- the health plane --------------------------------------------------
+
+    def health_payload(self) -> Dict[str, Any]:
+        """The ``{"op": "health"}`` response body — the engine's view
+        with the server's draining state folded in (``draining``
+        dominates ``degraded`` dominates ``ok``)."""
+        h = self.engine.health()
+        h["status"] = health_status(
+            draining=self._draining or bool(
+                self.handler is not None and self.handler.requested),
+            recovering=(h["status"] == "degraded"))
+        h["op"] = "health"
+        return h
+
     # -- request intake (reader threads -> inbox -> scheduler loop) --------
 
     def _handle_line(self, line: str, respond: Callable[[str], None]):
+        """Parse and act on one client line.  EVERY failure path answers
+        with a per-line error and counts it — the scheduler loop survives
+        any input (pinned by tests/test_serving_resilience.py)."""
+        try:
+            self._handle_line_inner(line, respond)
+        except Exception as e:  # one bad line must never kill the loop
+            self._count_bad_line()
+            try:
+                self._write(respond, {"id": None, "error": "bad_request",
+                                      "detail": f"line handling failed: {e}"})
+            except Exception:
+                pass
+
+    def _handle_line_inner(self, line: str,
+                           respond: Callable[[str], None]):
         line = line.strip()
         if not line:
             return
         try:
             req = json.loads(line)
         except ValueError:
+            self._count_bad_line()
             self._write(respond, {"id": None, "error": "bad_request",
                                   "detail": "unparseable JSON line"})
             return
         if not isinstance(req, dict):
+            self._count_bad_line()
             self._write(respond, {"id": None, "error": "bad_request",
                                   "detail": "expected {'id', 'video_id'}"})
+            return
+        op = req.get("op", "caption")
+        if op == "health":
+            if self.registry is not None:
+                self.registry.inc("serve_health_queries")
+            self._write(respond, self.health_payload())
+            return
+        if op != "caption":
+            self._count_bad_line()
+            self._write(respond, {"id": req.get("id"), "error": "unknown_op",
+                                  "op": op,
+                                  "detail": "expected op 'caption' or "
+                                            "'health'"})
             return
         rid = req.get("id")
         vid = req.get("video_id")
         if vid is None:
+            self._count_bad_line()
             self._write(respond, {"id": rid, "error": "bad_request",
                                   "detail": "expected {'id', 'video_id'}"})
             return
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+                if deadline_ms < 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                self._count_bad_line()
+                self._write(respond, {"id": rid, "error": "bad_request",
+                                      "detail": "deadline_ms must be a "
+                                                "number >= 0"})
+                return
         feats = self.feats_for(vid)
         if feats is None:
             self._write(respond, {"id": rid, "error": "unknown_video",
@@ -110,8 +219,10 @@ class CaptionServer:
         try:
             ok = self.engine.submit(
                 (rid, vid), [np.asarray(f) for f in feats],
-                meta={"id": rid, "video_id": vid, "respond": respond})
+                meta={"id": rid, "video_id": vid, "respond": respond},
+                deadline_ms=deadline_ms)
         except ValueError as e:
+            self._count_bad_line()
             self._write(respond, {"id": rid, "error": "bad_request",
                                   "detail": str(e)})
             return
@@ -124,15 +235,41 @@ class CaptionServer:
     # -- scheduler loop ----------------------------------------------------
 
     def _drain_and_exit(self) -> int:
-        done, rejected = self.engine.drain()
+        self._draining = True
+        # A SECOND signal during the drain aborts it — the operator's (or
+        # scheduler's) "stop now".  signal_count counts absorbed repeats;
+        # the baseline is read BEFORE the drain-start announcement, so any
+        # signal landing after the announcement is guaranteed to abort.
+        count0 = getattr(self.handler, "signal_count", 0)
+
+        def aborted() -> bool:
+            return getattr(self.handler, "signal_count", 0) > count0
+
+        print(f"serve: draining {self.engine.resident_count} resident(s), "
+              f"{self.engine.stats()['queue_depth']} queued; a second "
+              "signal aborts", file=sys.stderr)
+        sys.stderr.flush()
+        done, rejected = self.engine.drain(abort=aborted)
         for comp in done:
             self._respond_completion(comp)
-        for req in rejected:
+        self._respond_dropped_all()
+        unfinished = self.engine.resident_count
+        # An aborted drain abandons its residents (no partial captions) —
+        # but every request still gets an answer: the abandoned residents
+        # are rejected like the queued ones, so a client correlating ids
+        # never waits on a caption that will not come.
+        abandoned = self.engine.resident_requests()
+        for req in rejected + abandoned:
             meta = req.meta or {}
             self._write(meta.get("respond", self._stdout_respond),
                         {"id": meta.get("id"),
                          "video_id": meta.get("video_id"),
                          "error": "rejected_draining"})
+        if aborted():
+            print(f"serve: drain aborted by a second signal with "
+                  f"{unfinished} resident(s) unfinished; exiting "
+                  f"{EXIT_SIGTERM} (sigterm_unwind)", file=sys.stderr)
+            return EXIT_SIGTERM
         print(f"serve: drained {len(done)} in-flight, rejected "
               f"{len(rejected)} queued; exiting "
               f"{EXIT_PREEMPTED} (preempted/resumable)", file=sys.stderr)
@@ -140,6 +277,8 @@ class CaptionServer:
 
     def _loop(self) -> int:
         while True:
+            if self.watchdog is not None:
+                self.watchdog.beat()
             if self.handler is not None and self.handler.requested:
                 return self._drain_and_exit()
             moved = False
@@ -154,6 +293,8 @@ class CaptionServer:
             for comp in comps:
                 self._respond_completion(comp)
             if comps:
+                moved = True
+            if self._respond_dropped_all():
                 moved = True
             if self._eof.is_set() and self.engine.idle \
                     and self._inbox.empty():

@@ -45,8 +45,10 @@ class ProgressWatchdog:
 
     ``beat()`` is cheap (one monotonic read + store, no locking — a torn
     read just delays detection by one poll interval) and safe from any
-    thread.  A ``timeout_s`` of 0 disables the watchdog entirely; every
-    method is then a no-op, so call sites need no conditionals.
+    thread.  A ``timeout_s`` of 0 disables the kill policy; unless
+    heartbeat-only mode is armed (``heartbeat_path`` +
+    ``heartbeat_interval_s``), every method is then a no-op, so call
+    sites need no conditionals.
 
     Heartbeat file: with ``heartbeat_path`` set, the monitor thread also
     writes a small JSON status file at thread start and once per poll —
@@ -64,19 +66,39 @@ class ProgressWatchdog:
                  describe: Optional[Callable[[], str]] = None,
                  on_timeout: Optional[Callable[[float], None]] = None,
                  heartbeat_path: Optional[str] = None,
-                 payload: Optional[Callable[[], Dict]] = None):
+                 payload: Optional[Callable[[], Dict]] = None,
+                 heartbeat_interval_s: float = 0.0):
         self.timeout_s = float(timeout_s)
         self._describe = describe or (lambda: "")
         self._on_timeout = on_timeout or self._die
         self._heartbeat_path = heartbeat_path
         self._payload = payload
+        # Heartbeat-only mode (the serving health plane): a positive
+        # interval + a heartbeat path keep the monitor thread writing
+        # heartbeat.json even with the wedge timeout disabled (0), so a
+        # deployment can have liveness reporting without committing to a
+        # kill policy.  With a timeout too, the poll is the finer of the
+        # two cadences.
+        self._hb_interval = float(heartbeat_interval_s or 0.0)
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _armed(self) -> bool:
+        return self.timeout_s > 0 or (
+            self._heartbeat_path is not None and self._hb_interval > 0)
+
+    def _poll_s(self) -> float:
+        polls = []
+        if self.timeout_s > 0:
+            polls.append(max(1.0, min(30.0, self.timeout_s / 4.0)))
+        if self._heartbeat_path is not None and self._hb_interval > 0:
+            polls.append(max(0.05, self._hb_interval))
+        return min(polls)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ProgressWatchdog":
-        if self.timeout_s > 0 and self._thread is None:
+        if self._armed() and self._thread is None:
             self._stop.clear()
             self.beat()
             self._thread = threading.Thread(
@@ -125,12 +147,12 @@ class ProgressWatchdog:
             pass  # best-effort: a full disk must not look like a wedge
 
     def _run(self) -> None:
-        poll = max(1.0, min(30.0, self.timeout_s / 4.0))
+        poll = self._poll_s()
         self._write_heartbeat(time.monotonic() - self._last)
         while not self._stop.wait(poll):
             gap = time.monotonic() - self._last
             self._write_heartbeat(gap)
-            if gap > self.timeout_s:
+            if self.timeout_s > 0 and gap > self.timeout_s:
                 self._on_timeout(gap)
                 # The default handler never returns (os._exit).  An
                 # injected handler that does return wants continued

@@ -70,7 +70,10 @@ def build_demo_backend(opt):
     params["logit"] = {**params["logit"]}
     # Bias EOS so untrained captions terminate in a few steps (the
     # bench-probe trick) — the demo shows scheduling, not caption quality.
-    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(0.2)
+    # The chaos drills flip the bias negative (--serve_demo_eos_bias) to
+    # hold residents in flight for the drain/deadline windows.
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(
+        getattr(opt, "serve_demo_eos_bias", 0.2))
     rng = np.random.default_rng(0)
     table = [rng.standard_normal((DEMO_VIDEOS,) + s).astype(np.float32)
              for s in DEMO_FEAT_SHAPES]
@@ -106,22 +109,30 @@ def build_checkpoint_backend(opt, ds):
 
 def main(argv=None) -> int:
     opt = parse_opts(argv)
-    from cst_captioning_tpu.opts import warn_serving_decode_chunk
+    from cst_captioning_tpu.opts import (warn_serve_deadline,
+                                         warn_serving_decode_chunk)
     from cst_captioning_tpu.utils.platform import (configure_cli_logging,
                                                    enable_compile_cache)
 
     configure_cli_logging(opt.loglevel)
     warn_serving_decode_chunk(opt)
+    warn_serve_deadline(opt)
     enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
 
+    from cst_captioning_tpu.resilience.faults import FaultPlan
     from cst_captioning_tpu.resilience.preemption import PreemptionHandler
     from cst_captioning_tpu.serving.buckets import parse_buckets
-    from cst_captioning_tpu.serving.engine import ServingEngine
+    from cst_captioning_tpu.serving.engine import (ServingEngine,
+                                                   ServingUnrecoverable)
     from cst_captioning_tpu.serving.server import CaptionServer
     from cst_captioning_tpu.telemetry.registry import MetricsRegistry
 
     handler = PreemptionHandler().install()
     registry = MetricsRegistry()
+    plan = FaultPlan.parse(getattr(opt, "fault_plan", None))
+    if plan is not None:
+        plan.bind_metrics(registry)
+        log.warning("CHAOS: serving fault plan armed: %s", plan)
 
     ds = None
     if opt.serve_demo:
@@ -155,20 +166,59 @@ def main(argv=None) -> int:
         decode_chunk=getattr(opt, "decode_chunk", 8),
         bucket_sizes=parse_buckets(opt.serve_buckets),
         queue_limit=opt.serve_queue_limit,
+        deadline_ms=opt.serve_deadline_ms,
+        fault_plan=plan,
+        recover=bool(opt.serve_recover),
+        retry_limit=opt.serve_retry_limit,
+        rebuild_limit=opt.serve_rebuild_limit,
+        step_budget_ms=opt.serve_step_budget_ms,
         registry=registry, tracer=tracer)
     engine.warm()
-    log.info("engine warm: buckets=%s beam=%d chunk=%d queue_limit=%d",
+    log.info("engine warm: buckets=%s beam=%d chunk=%d queue_limit=%d "
+             "deadline_ms=%s recover=%d",
              engine.buckets, engine.beam_size, engine.chunk,
-             opt.serve_queue_limit)
+             opt.serve_queue_limit, opt.serve_deadline_ms,
+             int(opt.serve_recover))
 
-    server = CaptionServer(engine, vocab, feats_for, handler=handler)
+    server = CaptionServer(engine, vocab, feats_for, handler=handler,
+                           registry=registry)
+
+    # The serving health plane's liveness file: heartbeat.json once per
+    # second (watchdog atomic-write discipline) carrying the SAME health
+    # payload the {"op": "health"} query answers (so draining shows up in
+    # the file too) + registry counters; with --wedge_timeout the same
+    # watchdog also turns a wedged scheduler loop into a fast exit 124.
+    watchdog = None
+    if opt.serve_heartbeat_file or opt.wedge_timeout > 0:
+        from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
+
+        watchdog = ProgressWatchdog(
+            opt.wedge_timeout,
+            describe=lambda: "serving scheduler loop",
+            heartbeat_path=opt.serve_heartbeat_file,
+            payload=lambda: {"serving": server.health_payload(),
+                             **registry.heartbeat_payload()},
+            heartbeat_interval_s=1.0).start()
+        server.watchdog = watchdog
     try:
-        if opt.serve_port:
-            port = 0 if opt.serve_port < 0 else opt.serve_port
-            rc = server.run_socket(port)
-        else:
-            rc = server.run_stdin()
+        try:
+            if opt.serve_port:
+                port = 0 if opt.serve_port < 0 else opt.serve_port
+                rc = server.run_socket(port)
+            else:
+                rc = server.run_stdin()
+        except ServingUnrecoverable as e:
+            from cst_captioning_tpu.resilience.exitcodes import (
+                EXIT_WEDGE,
+                describe,
+            )
+
+            print(f"serve: UNRECOVERABLE: {e}; exiting {EXIT_WEDGE} "
+                  f"({describe(EXIT_WEDGE)})", file=sys.stderr)
+            rc = EXIT_WEDGE
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         stats = engine.stats()
         print("serve: " + json.dumps(stats), file=sys.stderr)
         if opt.result_file:
@@ -178,6 +228,7 @@ def main(argv=None) -> int:
 
             atomic_json_write(opt.result_file,
                               {"stats": stats,
+                               "health": engine.health(),
                                "telemetry": registry.snapshot()}, indent=2)
         if tracer is not None:
             tracer.close()

@@ -85,6 +85,14 @@ def main(argv=None) -> int:
         ("beam / chunk", f"{fmt(rec.get('beam_size'))} / "
                          f"{fmt(rec.get('decode_chunk'))}"),
         ("recompiles after warmup", fmt(rec.get("recompiles_after_warmup"))),
+        ("expired / deadline-shed", f"{fmt(rec.get('expired'))} / "
+                                    f"{fmt(rec.get('deadline_shed'))}"),
+        ("recovery", f"{fmt(rec.get('chunk_retries'))} chunk retries, "
+                     f"{fmt(rec.get('rebuilds'))} rebuilds "
+                     f"({fmt(rec.get('rebuild_recompiles'))} recompiled), "
+                     f"{fmt(rec.get('garble_detected'))} garbles / "
+                     f"{fmt(rec.get('wedge_detected'))} wedges / "
+                     f"{fmt(rec.get('admit_errors'))} admit errors seen"),
         ("platform", f"{rec.get('platform')}"
                      + (" (CPU FALLBACK — not a device number)"
                         if rec.get("cpu_fallback") else "")),
@@ -94,12 +102,18 @@ def main(argv=None) -> int:
                              else ""))
     for k, v in rows:
         print(f"  {k:<{width}}  {v}")
+    rc = 0
     recomp = rec.get("recompiles_after_warmup")
     if recomp not in (0, None):
         print("  !! recompiles under steady load: the bucket discipline "
               "is broken (SERVING.md)", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if rec.get("rebuild_recompiles") not in (0, None):
+        print("  !! an engine rebuild compiled new programs: recovery "
+              "must re-warm from the existing ProgramCache "
+              "(RESILIENCE.md 'Serving faults')", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

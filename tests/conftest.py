@@ -44,6 +44,7 @@ os.environ["CST_TUNED_CONFIGS"] = ""
 # to the built-in defaults; serving tests pass explicit values instead.
 os.environ["CST_SERVE_BUCKETS"] = ""
 os.environ["CST_SERVE_QUEUE_LIMIT"] = ""
+os.environ["CST_SERVE_DEADLINE_MS"] = ""
 
 import jax  # noqa: E402
 

@@ -55,6 +55,25 @@ def run_bench(*extra):
     return json.loads(lines[0])
 
 
+def test_probe_backend_backoff_and_structured_diagnostic():
+    """ISSUE 9 satellite: the platform probe retries with backoff and, on
+    total failure, returns a classified machine-auditable record (kind +
+    per-attempt latencies) instead of a silent CPU fallback.  A 1ms
+    timeout forces every attempt to time out (jax init takes ~1s)."""
+    sys.path.insert(0, REPO)
+    from bench import probe_backend
+
+    plat, info = probe_backend(0.001, retries=1, backoff_s=0.01)
+    assert plat is None
+    assert info["kind"] == "probe_timeout"
+    assert info["timeouts"] == 2 and len(info["attempts"]) == 2
+    for rec in info["attempts"]:
+        assert rec["outcome"] == "timeout" and rec["latency_s"] >= 0
+    # the backoff is recorded on every non-final attempt
+    assert info["attempts"][0]["backoff_s"] == pytest.approx(0.01)
+    assert info["backoff_s"] == 0.01
+
+
 def test_default_emits_both_stages():
     out = run_bench()
     assert out["metric"] == "min_xe_cst_captions_per_sec_per_chip"

@@ -1,0 +1,782 @@
+"""Fault-tolerant serving (ISSUE 9): deadlines, self-healing, health plane.
+
+Fast slice (tier-1):
+- the serving fault grammar (``serve_wedge@req=N`` / ``serve_garble@req=N``
+  / ``admit_err@req=N``) and the shared garble/health helpers
+  (``resilience/garble.py``);
+- THE acceptance drill, in-process: a seeded run under all three injected
+  serving faults completes every request with captions BIT-IDENTICAL to
+  the fault-free twin, zero program builds after warmup (including across
+  an engine rebuild), and every injected fault reflected in the
+  registry counters — machine-checked, not eyeballed;
+- the recovery ladder's escalation: retry -> rebuild (re-warmed from the
+  ProgramCache, replay verified against persisted prefixes) ->
+  ``ServingUnrecoverable``;
+- request deadlines: mid-flight TTL eviction freeing the slot for the
+  next queued request, queued expiry, p99-unmeetable shedding, the
+  deadline-slack histogram, per-request override;
+- the hardened JSONL intake (malformed line / unknown op / bad deadline
+  -> per-line error + counter, never a dead scheduler loop) and the
+  ``{"op": "health"}`` ok|degraded|draining contract;
+- the double-signal drain abort (first TERM drains, second exits hard
+  through the taxonomy) at the engine and server levels;
+- doc pins: RESILIENCE.md lists every serving fault kind + the recovery
+  escalation table; SERVING.md lists every engine counter.
+
+The subprocess drills (scripts/serve.py under a real ``--fault_plan``,
+real double SIGTERM, the heartbeat file) are marked ``slow`` and run via
+``make serve-chaos``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.beam import beam_search
+from cst_captioning_tpu.ops.sampling import sample_captions
+from cst_captioning_tpu.resilience.faults import FaultPlan, InjectedFault
+from cst_captioning_tpu.resilience.garble import (
+    GarbledChunk,
+    all_zero,
+    garbled_decode_slots,
+    health_status,
+)
+from cst_captioning_tpu.serving.engine import (
+    COUNTERS,
+    ServingEngine,
+    ServingUnrecoverable,
+)
+from cst_captioning_tpu.serving.server import CaptionServer
+from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def make_variables(model, feats, eos_bias=0.4):
+    variables = model.init(jax.random.PRNGKey(0), feats,
+                           np.zeros((B, MAX_LEN), np.int32))
+    params = {**variables["params"]}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(eos_bias)
+    return {"params": params}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    feats_np = np.random.default_rng(0).normal(
+        size=(B, T, D)).astype(np.float32) * 2.0
+    variables = make_variables(model, [jnp.asarray(feats_np)])
+    return model, variables, feats_np
+
+
+@pytest.fixture(scope="module")
+def long_setup():
+    """EOS-suppressed twin: captions run the full MAX_LEN, so residents
+    stay in flight long enough for deterministic TTL-eviction drills."""
+    model = CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    feats_np = np.random.default_rng(7).normal(
+        size=(B, T, D)).astype(np.float32) * 2.0
+    variables = make_variables(model, [jnp.asarray(feats_np)],
+                               eos_bias=-8.0)
+    return model, variables, feats_np
+
+
+def submit_all(engine, feats_np, n=None):
+    for i in range(n if n is not None else feats_np.shape[0]):
+        assert engine.submit(i, [feats_np[i]])
+
+
+def tokens_by_id(completions):
+    return {c.request_id: c.tokens for c in completions}
+
+
+# -- grammar + shared helpers ----------------------------------------------
+
+
+def test_serving_fault_grammar_parses():
+    plan = FaultPlan.parse(
+        "serve_wedge@req=1,serve_garble@req=2,admit_err@req=0")
+    assert plan.pending("serve_wedge") == 1
+    assert plan.fire("serve_garble", 2) and not plan.fire("serve_garble", 2)
+    with pytest.raises(ValueError, match="keys on 'req'"):
+        FaultPlan.parse("serve_wedge@step=1")
+    with pytest.raises(ValueError, match="keys on 'step'"):
+        FaultPlan.parse("wedge@req=1")
+
+
+def test_serving_fault_cli_usage_error():
+    from cst_captioning_tpu.opts import parse_opts
+
+    with pytest.raises(SystemExit) as exc:
+        parse_opts(["--fault_plan", "serve_wedge@step=3"])
+    assert exc.value.code == 2
+    ns = parse_opts(["--fault_plan", "serve_garble@req=3"])
+    assert ns.fault_plan == "serve_garble@req=3"
+
+
+def test_all_zero_signature():
+    assert all_zero([0.0, 0.0, 0.0])
+    assert all_zero(np.zeros((3, 4), np.int32))
+    assert not all_zero([0.0, 1e-30])
+    assert not all_zero([])                 # empty is not a signature
+
+
+def test_garbled_decode_slots_flags_impossible_rows():
+    # greedy shape (slots, chunk): live row, not finished, all-zero chunk
+    # = the impossible signature; a finished all-zero row is the normal
+    # EOS-extension no-op and must NOT be flagged.
+    toks = np.array([[0, 0], [3, 4], [0, 0]], np.int32)
+    fin = np.array([False, False, True])
+    assert garbled_decode_slots(toks, fin, [0, 1, 2]) == [0]
+    assert garbled_decode_slots(toks, fin, [1, 2]) == []
+    # beam shape (slots, chunk, k)
+    btoks = np.zeros((2, 2, 3), np.int32)
+    btoks[1, 0, 0] = 5
+    bfin = np.array([False, False])
+    assert garbled_decode_slots(btoks, bfin, [0, 1]) == [0]
+
+
+def test_health_status_words():
+    assert health_status(draining=False, recovering=False) == "ok"
+    assert health_status(draining=False, recovering=True) == "degraded"
+    assert health_status(draining=True, recovering=True) == "draining"
+
+
+# -- THE acceptance drill: chaos-drilled self-healing, bit-identical -------
+
+
+def test_chaos_drill_greedy_bit_identical_zero_recompiles(setup):
+    """Acceptance: under serve_wedge + serve_garble + admit_err, every
+    request completes with captions bit-identical to the fault-free run,
+    zero program builds after warmup, and every injected fault lands in
+    the counters."""
+    model, variables, feats_np = setup
+    offline, _ = sample_captions(model, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    plan = FaultPlan.parse(
+        "serve_wedge@req=1,serve_garble@req=2,admit_err@req=3")
+    registry = MetricsRegistry()
+    plan.bind_metrics(registry)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           fault_plan=plan, recover=True,
+                           registry=registry)
+    warm_builds = engine.warm()["compiles"]
+    submit_all(engine, feats_np)
+    got = tokens_by_id(engine.run_until_idle())
+    # Every request completed, bit-identical to the offline decode.
+    assert sorted(got) == list(range(B))
+    np.testing.assert_array_equal(
+        np.stack([got[i] for i in range(B)]), np.asarray(offline))
+    # Zero post-warmup compiles — recovery re-ran and re-admitted through
+    # the warm ProgramCache, it never rebuilt a program.
+    stats = engine.stats()
+    assert stats["compiles"] == warm_builds
+    # Each injected fault is visible in the audit trail.
+    snap = registry.snapshot()["counters"]
+    assert snap["serve_wedge_detected"] == 1
+    assert snap["serve_garble_detected"] == 1
+    assert snap["serve_admit_errors"] == 1
+    assert snap["serve_chunk_retries"] == 2      # one wedge + one garble
+    assert snap["serve_rebuilds"] == 0
+    assert snap["serve_replay_divergence"] == 0
+    assert snap["fault_firings"] == 3            # the plan's own audit
+    assert plan.pending("serve_wedge") == 0
+    # Recovery events within the window: the health plane reads degraded.
+    assert engine.health()["status"] == "degraded"
+    assert stats["completed"] == B and stats["expired"] == 0
+
+
+def test_chaos_drill_escalates_to_rebuild_zero_recompiles(setup):
+    """retry_limit=0 sends the first garble straight up the ladder: the
+    engine rebuilds — fresh slot state, residents re-admitted from their
+    persisted requests, ZERO new program builds — and the deterministic
+    replay still lands bit-identical captions (prefix-verified)."""
+    model, variables, feats_np = setup
+    offline, _ = sample_captions(model, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    registry = MetricsRegistry()
+    plan = FaultPlan.parse("serve_garble@req=1").bind_metrics(registry)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           fault_plan=plan, recover=True, retry_limit=0,
+                           registry=registry)
+    warm_builds = engine.warm()["compiles"]
+    submit_all(engine, feats_np)
+    got = tokens_by_id(engine.run_until_idle())
+    np.testing.assert_array_equal(
+        np.stack([got[i] for i in range(B)]), np.asarray(offline))
+    stats = engine.stats()
+    assert stats["rebuilds"] == 1
+    assert stats["rebuild_recompiles"] == 0      # the compile-once contract
+    assert stats["compiles"] == warm_builds
+    snap = registry.snapshot()["counters"]
+    assert snap["serve_rebuilds"] == 1
+    assert snap["serve_rebuild_recompiles"] == 0
+    assert snap["serve_replay_divergence"] == 0
+
+
+def test_chaos_drill_beam_bit_identical(setup):
+    model, variables, feats_np = setup
+    best, _, _ = beam_search(model, variables, [jnp.asarray(feats_np)],
+                             beam_size=3, max_len=MAX_LEN, length_norm=0.7)
+    plan = FaultPlan.parse("serve_wedge@req=0,serve_garble@req=2")
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           beam_size=3, length_norm=0.7, decode_chunk=2,
+                           bucket_sizes=(2,), queue_limit=0,
+                           fault_plan=plan, recover=True)
+    engine.warm()
+    submit_all(engine, feats_np)
+    got = tokens_by_id(engine.run_until_idle())
+    np.testing.assert_array_equal(
+        np.stack([got[i] for i in range(B)]), np.asarray(best))
+    assert engine.stats()["chunk_retries"] == 2
+
+
+def test_recovery_disabled_detects_but_proceeds(setup):
+    """recover=0 (legacy donated fast path): the garble detector still
+    counts the impossible signature, but nothing is re-run — detection
+    without healing, never a crash."""
+    model, variables, feats_np = setup
+    plan = FaultPlan.parse("serve_garble@req=1")
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           fault_plan=plan, recover=False)
+    submit_all(engine, feats_np, n=3)
+    got = tokens_by_id(engine.run_until_idle())
+    assert sorted(got) == [0, 1, 2]
+    assert engine.stats()["garble_detected"] == 1
+    assert engine.stats()["chunk_retries"] == 0
+
+
+class _AlwaysWedge:
+    """A fault plan stub that wedges EVERY chunk dispatch — the
+    reproducible-failure case the single-shot plan grammar cannot
+    express, driving the ladder to its unrecoverable end."""
+
+    def fire(self, kind, index):
+        return kind == "serve_wedge"
+
+
+def test_ladder_exhaustion_raises_unrecoverable(setup):
+    model, variables, feats_np = setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           fault_plan=_AlwaysWedge(), recover=True,
+                           retry_limit=1, rebuild_limit=1)
+    engine.warm()
+    engine.submit(0, [feats_np[0]])
+    with pytest.raises(ServingUnrecoverable, match="rebuild"):
+        engine.run_until_idle()
+    assert engine.stats()["rebuilds"] == 1
+
+
+def test_unrecoverable_maps_to_wedge_exit_code():
+    from cst_captioning_tpu.resilience.exitcodes import EXIT_WEDGE, classify
+
+    assert classify(EXIT_WEDGE) == "wedge"       # supervisors restart it
+
+
+# -- request deadlines & TTL eviction --------------------------------------
+
+
+def test_expired_resident_frees_slot_and_next_request_is_admitted(
+        long_setup):
+    """The TTL tentpole pin: a resident past its deadline is evicted
+    mid-flight (drop record, slot freed) and the next queued request is
+    admitted into the recycled slot and completes normally."""
+    model, variables, feats_np = long_setup
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           registry=registry, clock=clock)
+    assert engine.submit(0, [feats_np[0]], deadline_ms=3000)
+    assert engine.submit(1, [feats_np[1]])       # no deadline
+    done = engine.step()                         # 0 admitted, mid-flight
+    assert done == [] and engine.resident_count == 1
+    clock.tick(5.0)                              # past request 0's deadline
+    done = engine.run_until_idle()
+    drops = engine.pop_dropped()
+    assert [d.request_id for d in drops] == [0]
+    assert drops[0].reason == "expired" and drops[0].where == "resident"
+    assert [c.request_id for c in done] == [1]
+    assert done[0].slot == 0                     # the recycled slot
+    snap = registry.snapshot()["counters"]
+    assert snap["serve_expired"] == 1 and snap["serve_completed"] == 1
+
+
+def test_queued_request_expires_before_admission(long_setup):
+    model, variables, feats_np = long_setup
+    clock = FakeClock()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           clock=clock)
+    engine.submit(0, [feats_np[0]])              # occupies the only slot
+    engine.step()
+    engine.submit(1, [feats_np[1]], deadline_ms=1000)
+    clock.tick(2.0)                              # queued past its deadline
+    engine.run_until_idle()
+    drops = engine.pop_dropped()
+    assert [(d.request_id, d.where) for d in drops] == [(1, "queued")]
+
+
+def test_unmeetable_deadline_is_shed_at_p99_chunk_latency(long_setup):
+    """A queued deadline smaller than one p99 chunk provably cannot be
+    met: shed before admission, with its own counter."""
+    model, variables, feats_np = long_setup
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           registry=registry, clock=clock)
+    engine._chunk_wall.extend([0.5] * 8)         # p99 chunk = 500ms
+    engine.submit(0, [feats_np[0]], deadline_ms=100)   # < one chunk
+    engine.submit(1, [feats_np[1]], deadline_ms=60000)
+    got = tokens_by_id(engine.run_until_idle())
+    drops = engine.pop_dropped()
+    assert [d.request_id for d in drops] == [0]
+    assert drops[0].reason == "deadline_shed"
+    assert sorted(got) == [1]
+    assert registry.snapshot()["counters"]["serve_deadline_shed"] == 1
+
+
+def test_default_deadline_and_override_and_slack_histogram(setup):
+    model, variables, feats_np = setup
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           deadline_ms=60000, registry=registry, clock=clock)
+    engine.submit(0, [feats_np[0]])                    # engine default
+    engine.submit(1, [feats_np[1]], deadline_ms=90000)  # override
+    engine.submit(2, [feats_np[2]], deadline_ms=0)      # explicit no-TTL
+    reqs = {r.index: r for r in engine._queue}
+    assert reqs[0].deadline == pytest.approx(60.0)
+    assert reqs[1].deadline == pytest.approx(90.0)
+    assert reqs[2].deadline is None
+    engine.run_until_idle()
+    hist = registry.snapshot()["histograms"]["serve_deadline_slack_ms"]
+    assert hist["count"] == 2                    # only deadline-carrying
+    assert hist["min"] > 0                       # all completed in time
+
+
+# -- hardened JSONL intake + the health op ---------------------------------
+
+
+@pytest.fixture()
+def server(setup):
+    model, variables, feats_np = setup
+    registry = MetricsRegistry()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=2,
+                           registry=registry)
+
+    def feats_for(video_id):
+        try:
+            ix = int(str(video_id).lstrip("v"))
+        except ValueError:
+            return None
+        return [feats_np[ix]] if 0 <= ix < B else None
+
+    class Vocab:
+        def decode(self, toks):
+            return " ".join(f"w{t}" for t in np.asarray(toks) if t)
+
+    class Handler:
+        requested = False
+        signal_count = 0
+
+    srv = CaptionServer(engine, Vocab(), feats_for, handler=Handler(),
+                        registry=registry)
+    replies = []
+    return srv, registry, replies, (lambda line: replies.append(
+        json.loads(line)))
+
+
+def test_intake_survives_malformed_lines_with_counted_errors(server):
+    """Satellite pin: a malformed line or unknown op yields a per-line
+    error response + counter — the scheduler loop survives any input.
+    (Pre-ISSUE-9 behavior already answered unparseable JSON with
+    bad_request; this pins it and adds the counter + op dispatch.)"""
+    srv, registry, replies, respond = server
+    srv._handle_line("this is not json", respond)
+    srv._handle_line("[1, 2, 3]", respond)
+    srv._handle_line('{"id": 7}', respond)                  # no video_id
+    srv._handle_line('{"id": 8, "op": "selfdestruct"}', respond)
+    srv._handle_line('{"id": 9, "video_id": "v0", "deadline_ms": "soon"}',
+                     respond)
+    srv._handle_line('{"id": 10, "video_id": "nope"}', respond)
+    assert [r.get("error") for r in replies] == [
+        "bad_request", "bad_request", "bad_request", "unknown_op",
+        "bad_request", "unknown_video"]
+    assert replies[3]["op"] == "selfdestruct"
+    # unknown_video is a classified miss, not a malformed line.
+    assert registry.snapshot()["counters"]["serve_bad_lines"] == 5
+    # ...and a good line still works after all of that.
+    srv._handle_line('{"id": 11, "video_id": "v0"}', respond)
+    assert srv.engine.stats()["queue_depth"] == 1
+
+
+def test_health_op_reports_ok_degraded_draining(server):
+    srv, registry, replies, respond = server
+    srv._handle_line('{"op": "health"}', respond)
+    assert replies[-1]["op"] == "health"
+    assert replies[-1]["status"] == "ok"
+    assert replies[-1]["queue_depth"] == 0
+    assert set(replies[-1]["recovery"]) >= {
+        "expired", "chunk_retries", "rebuilds", "garble_detected"}
+    # A recovery event inside the window reads degraded...
+    srv.engine._note_recovery_event()
+    srv._handle_line('{"op": "health"}', respond)
+    assert replies[-1]["status"] == "degraded"
+    # ...and a drain in progress dominates everything.
+    srv.handler.requested = True
+    srv._handle_line('{"op": "health"}', respond)
+    assert replies[-1]["status"] == "draining"
+    assert registry.snapshot()["counters"]["serve_health_queries"] == 3
+
+
+def test_expired_request_gets_explicit_response(long_setup):
+    model, variables, feats_np = long_setup
+    clock = FakeClock()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           clock=clock)
+
+    class Vocab:
+        def decode(self, toks):
+            return "x"
+
+    replies = []
+    respond = lambda line: replies.append(json.loads(line))
+    srv = CaptionServer(engine, Vocab(),
+                        lambda vid: [feats_np[0]] if vid == "v0" else None)
+    srv._handle_line('{"id": 1, "video_id": "v0", "deadline_ms": 1000}',
+                     respond)
+    engine.step()                                # admitted, mid-flight
+    clock.tick(9.0)                              # deadline long gone
+    while not engine.idle:
+        engine.step()
+    assert srv._respond_dropped_all()
+    assert replies[-1]["error"] == "expired"
+    assert replies[-1]["where"] == "resident"
+    assert replies[-1]["id"] == 1 and replies[-1]["video_id"] == "v0"
+
+
+# -- drain: first signal drains, second aborts hard ------------------------
+
+
+def test_engine_drain_abort_stops_mid_drain(long_setup):
+    model, variables, feats_np = long_setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0)
+    submit_all(engine, feats_np)
+    engine.step()                                # 2 residents mid-flight
+    steps = []
+    done, rejected = engine.drain(
+        abort=lambda: len(steps) >= 1 or steps.append(1))
+    assert [r.request_id for r in rejected] == [2, 3, 4]
+    assert done == []                            # aborted before finishing
+    assert engine.resident_count == 2            # abandoned, honest
+
+
+def test_server_double_signal_drain_exits_143(long_setup):
+    """First TERM -> drain; a second signal mid-drain -> abort, exit
+    EXIT_SIGTERM (sigterm_unwind in the taxonomy)."""
+    from cst_captioning_tpu.resilience.exitcodes import (
+        EXIT_SIGTERM,
+        classify,
+    )
+
+    model, variables, feats_np = long_setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0)
+
+    class Handler:
+        requested = True
+        signal_count = 1
+
+    class Vocab:
+        def decode(self, toks):
+            return "x"
+
+    handler = Handler()
+    srv = CaptionServer(engine, Vocab(), lambda vid: None, handler=handler,
+                        out=open(os.devnull, "w"))
+    submit_all(engine, feats_np)
+    engine.step()
+    orig_step = engine.step
+    calls = []
+
+    def step_with_second_signal():
+        calls.append(1)
+        if len(calls) == 1:
+            handler.signal_count += 1            # the second TERM lands
+        return orig_step()
+
+    engine.step = step_with_second_signal
+    rc = srv._drain_and_exit()
+    assert rc == EXIT_SIGTERM
+    assert classify(rc) == "resumable"
+    assert engine.resident_count > 0             # drain really aborted
+
+
+def test_server_single_signal_drain_exits_75(setup):
+    from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+
+    model, variables, feats_np = setup
+
+    class Handler:
+        requested = True
+        signal_count = 1
+
+    class Vocab:
+        def decode(self, toks):
+            return "x"
+
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0)
+    srv = CaptionServer(engine, Vocab(), lambda vid: None, handler=Handler(),
+                        out=open(os.devnull, "w"))
+    submit_all(engine, feats_np, n=3)
+    engine.step()
+    assert srv._drain_and_exit() == EXIT_PREEMPTED
+    assert engine.idle
+
+
+# -- opts: the unmeetable-deadline warn-once -------------------------------
+
+
+def test_warn_once_deadline_below_chunk_budget(capsys):
+    import cst_captioning_tpu.opts as opts
+
+    opts._warned_serve_deadline = False
+    opts.parse_opts(["--engine", "serving", "--serve_deadline_ms", "10",
+                     "--serve_step_budget_ms", "250"])
+    err = capsys.readouterr().err
+    assert err.count("can never be met") == 1
+    assert "--serve_deadline_ms 10" in err and "8 slots" in err
+    opts.parse_opts(["--engine", "serving", "--serve_deadline_ms", "10",
+                     "--serve_step_budget_ms", "250"])
+    assert "can never be met" not in capsys.readouterr().err   # warn-once
+    # A meetable deadline (or no budget) stays silent.
+    opts._warned_serve_deadline = False
+    opts.parse_opts(["--engine", "serving", "--serve_deadline_ms", "500",
+                     "--serve_step_budget_ms", "250"])
+    opts.parse_opts(["--engine", "serving", "--serve_deadline_ms", "10"])
+    assert "can never be met" not in capsys.readouterr().err
+
+
+# -- doc pins --------------------------------------------------------------
+
+
+def test_resilience_doc_pins_serving_fault_kinds_and_escalation():
+    """RESILIENCE.md's serving fault section is sourced from the code:
+    every req-axis kind documented, the escalation ladder's knobs and
+    terminal exit code named — docs and code cannot drift."""
+    from cst_captioning_tpu.resilience.faults import KINDS
+
+    with open(os.path.join(REPO, "RESILIENCE.md")) as f:
+        text = f.read()
+    for kind, axis in KINDS.items():
+        assert kind in text, f"RESILIENCE.md missing fault kind {kind}"
+        if axis == "req":
+            assert f"`{kind}@req=N`" in text, \
+                f"RESILIENCE.md missing serving grammar for {kind}"
+    for token in ("--serve_retry_limit", "--serve_rebuild_limit",
+                  "rebuild", "124", "serve_rebuild_recompiles"):
+        assert token in text, f"RESILIENCE.md escalation table missing "\
+                              f"{token!r}"
+
+
+def test_serving_doc_pins_engine_counters():
+    with open(os.path.join(REPO, "SERVING.md")) as f:
+        text = f.read()
+    for name in COUNTERS:
+        assert name in text, f"SERVING.md telemetry table missing {name}"
+    for token in ("deadline", "expired", "ok|degraded|draining"):
+        assert token in text
+
+
+# -- serve_report: the rebuild-recompile violation gate --------------------
+
+
+def _run_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def test_serve_report_renders_recovery_and_gates_on_rebuild_recompiles(
+        tmp_path):
+    record = {"metric": "serve_captions_per_sec_per_chip", "value": 10.0,
+              "latency_p50_ms": 1.0, "latency_p99_ms": 2.0,
+              "completed": 4, "num_requests": 4, "shed": 0,
+              "recompiles_after_warmup": 0, "expired": 1,
+              "deadline_shed": 2, "chunk_retries": 3, "rebuilds": 1,
+              "rebuild_recompiles": 0, "garble_detected": 1,
+              "wedge_detected": 2, "admit_errors": 0, "platform": "cpu"}
+    proc = _run_report(record, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "1 rebuilds (0 recompiled)" in proc.stdout
+    assert "1 / 2" in proc.stdout                # expired / deadline-shed
+    # A rebuild that recompiled breaks the ProgramCache re-warm contract:
+    # the report FAILS so CI catches it.
+    proc = _run_report({**record, "rebuild_recompiles": 1}, tmp_path)
+    assert proc.returncode == 1
+    assert "rebuild compiled new programs" in proc.stderr
+
+
+# -- slow subprocess drills (make serve-chaos) -----------------------------
+
+
+def _run_serve(requests, extra, timeout=240):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+         "--serve_demo", "1", "--beam_size", "1", "--max_length", "8",
+         "--loglevel", "WARNING"] + extra,
+        input="".join(json.dumps(r) + "\n" for r in requests),
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout)
+    replies = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    return proc, replies
+
+
+@pytest.mark.slow
+def test_serve_cli_chaos_drill_bit_identical(tmp_path):
+    """The acceptance drill through the real CLI: scripts/serve.py under
+    a seeded --fault_plan answers every request with captions identical
+    to the fault-free twin, stamps the fault counters into the stats
+    file, and writes a live heartbeat with the serving health payload."""
+    reqs = [{"id": i, "video_id": f"v{i}"} for i in range(6)]
+    clean, clean_replies = _run_serve(reqs, [])
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    hb = tmp_path / "heartbeat.json"
+    result = tmp_path / "serve_stats.json"
+    faulted, fault_replies = _run_serve(reqs, [
+        "--fault_plan", "serve_wedge@req=1,serve_garble@req=3,admit_err@req=4",
+        "--serve_recover", "1", "--result_file", str(result),
+        "--serve_heartbeat_file", str(hb)])
+    assert faulted.returncode == 0, faulted.stderr[-2000:]
+    assert faulted.stderr.count("FAULT INJECTED") == 3
+    by_id = lambda rs: {r["id"]: r.get("caption") for r in rs}
+    assert by_id(fault_replies) == by_id(clean_replies)
+    assert all(c is not None for c in by_id(clean_replies).values())
+    with open(result) as f:
+        doc = json.load(f)
+    stats = doc["stats"]
+    assert stats["wedge_detected"] == 1
+    assert stats["garble_detected"] == 1
+    assert stats["admit_errors"] == 1
+    assert stats["rebuild_recompiles"] == 0
+    assert doc["telemetry"]["counters"]["fault_firings"] == 3
+    assert doc["health"]["status"] in ("ok", "degraded")
+    assert doc["health"]["recovery"]["chunk_retries"] == 2
+    with open(hb) as f:
+        beat = json.load(f)
+    assert beat["serving"]["recovery"]["wedge_detected"] == 1
+    assert "counters" in beat
+
+
+@pytest.mark.slow
+def test_serve_cli_double_sigterm_exits_hard():
+    """First TERM drains; a second TERM mid-drain aborts it and exits
+    143 (sigterm_unwind).  The demo model's EOS is suppressed
+    (--serve_demo_eos_bias -8) so every resident decodes the full 60
+    steps — a drain window of many chunk dispatches — and the second
+    TERM is made un-missable by freezing the server (SIGSTOP) as soon as
+    the first TERM's PREEMPT ack appears, queuing the TERM, and resuming
+    (SIGCONT): the drain-loop's abort check sees it on the very next
+    iteration."""
+    import threading
+
+    from cst_captioning_tpu.resilience.exitcodes import (
+        EXIT_SIGTERM,
+        classify,
+    )
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+         "--serve_demo", "1", "--serve_demo_eos_bias", "-8",
+         "--beam_size", "1", "--max_length", "500", "--decode_chunk", "1",
+         "--serve_buckets", "8", "--loglevel", "WARNING"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=REPO, env=env)
+    errlines = []
+    draining_seen = threading.Event()
+
+    def read_err():
+        for line in proc.stderr:
+            errlines.append(line.rstrip())
+            if "serve: draining" in line:
+                draining_seen.set()
+
+    threading.Thread(target=read_err, daemon=True).start()
+    try:
+        for i in range(12):
+            proc.stdin.write(json.dumps(
+                {"id": i, "video_id": f"v{i % 8}"}) + "\n")
+        # The health op is answered by the SAME scheduler loop, after the
+        # FIFO inbox — its reply proves startup finished and every
+        # request above was submitted (TERMing during the slow jax init
+        # would otherwise drain an empty engine and prove nothing).
+        proc.stdin.write('{"op": "health"}\n')
+        proc.stdin.flush()
+        health = json.loads(proc.stdout.readline())
+        assert health["op"] == "health"
+        time.sleep(0.05)       # a few chunks into the 500-step captions
+        proc.send_signal(signal.SIGTERM)
+        # The drain-start announcement is printed AFTER the abort
+        # baseline is read, so a signal from here on must abort.
+        assert draining_seen.wait(60), "drain never started"
+        proc.send_signal(signal.SIGSTOP)
+        proc.send_signal(signal.SIGTERM)       # pending while frozen
+        proc.send_signal(signal.SIGCONT)
+        proc.wait(timeout=120)
+        err = "\n".join(errlines)
+        assert proc.returncode == EXIT_SIGTERM, (proc.returncode, err[-2000:])
+        assert classify(proc.returncode) == "resumable"
+        assert "drain aborted" in err
+        assert "0 resident(s) unfinished" not in err, \
+            "degenerate drill: nothing was actually in flight"
+        # Every request still got an answer: the abandoned residents are
+        # rejected like the queued ones, never silently dropped.
+        replies = [json.loads(l) for l in proc.stdout.read().splitlines()
+                   if l.strip()]
+        rejected = {r["id"] for r in replies
+                    if r.get("error") == "rejected_draining"}
+        answered = {r["id"] for r in replies if "id" in r}
+        assert rejected and answered == set(range(12))
+    finally:
+        proc.kill()

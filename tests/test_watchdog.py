@@ -157,6 +157,46 @@ class TestHeartbeatFile:
         assert doc["last_train"]["step"] == 7
         assert doc["last_train"]["compute_ms"] == 12.5
 
+    def test_heartbeat_only_mode_without_kill_policy(self, tmp_path):
+        """ISSUE 9 (serving health plane): heartbeat_interval_s + a path
+        arm the monitor thread with timeout 0 — liveness reporting with
+        NO kill policy, repolled at the interval, never firing
+        on_timeout."""
+        hb = tmp_path / "heartbeat.json"
+        fired = []
+        wd = ProgressWatchdog(0.0, heartbeat_path=str(hb),
+                              payload=lambda: {"serving": {"status": "ok"}},
+                              on_timeout=lambda gap: fired.append(gap),
+                              heartbeat_interval_s=0.05)
+        wd.start()
+        try:
+            assert wd._thread is not None, "heartbeat-only mode never armed"
+            deadline = time.time() + 10.0
+            while not hb.exists() and time.time() < deadline:
+                time.sleep(0.02)
+            assert hb.exists()
+            first = json.loads(hb.read_text())["time"]
+            # The poll cadence follows the interval, not the 1s floor of
+            # the timeout-derived poll: a rewrite lands well inside 10s.
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if json.loads(hb.read_text())["time"] > first:
+                    break
+                time.sleep(0.02)
+            assert json.loads(hb.read_text())["time"] > first
+        finally:
+            wd.stop()
+        doc = json.loads(hb.read_text())
+        assert doc["serving"]["status"] == "ok"
+        assert doc["timeout_s"] == 0.0
+        assert fired == [], "heartbeat-only mode must never kill"
+
+    def test_no_heartbeat_no_timeout_stays_noop(self):
+        wd = ProgressWatchdog(0.0, heartbeat_interval_s=1.0)  # no path
+        wd.start()
+        assert wd._thread is None
+        wd.stop()
+
     def test_stop_writes_final_state(self, tmp_path):
         from cst_captioning_tpu.telemetry import MetricsRegistry
 
