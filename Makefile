@@ -31,14 +31,28 @@ TRAIN_COMMON = \
   --val_cocofmt_file $(DATA)/val_cocofmt.json \
   --batch_size $(BATCH) --seq_per_img $(SEQ_PER_IMG)
 
-.PHONY: test chaos xe wxe cst cst_scb cst_host eval bench demo trace-demo \
-        scale_chain report collect chip_window tune tune-fast tune-report \
-        serve-demo serve-bench serve-chaos clean
+.PHONY: test lint lint-json chaos xe wxe cst cst_scb cst_host eval bench \
+        demo trace-demo scale_chain report collect chip_window tune \
+        tune-fast tune-report serve-demo serve-bench serve-chaos clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# Project-native static analysis (ANALYSIS.md): mechanically enforce the
+# RESILIENCE.md/SERVING.md invariants — no device-scalar fetches in hot
+# loops, durable JSON through atomic_json_write, counters declared at 0,
+# exits through the taxonomy, no silent exception swallows, every
+# donated jit buffer actually aliased.  Exit 0 = clean tree (every
+# suppression carries a written justification); the same run rides in
+# tier-1 via tests/test_cstlint.py.  `lint-json` emits the machine
+# report that collect_evidence bundles into MANIFESTs.
+lint:
+	JAX_PLATFORMS=cpu $(PY) scripts/cstlint.py
+
+lint-json:
+	JAX_PLATFORMS=cpu $(PY) scripts/cstlint.py --json
 
 # Chaos drills (RESILIENCE.md): drive the real trainer through injected
 # faults — torn checkpoints, NaN gradients, loader errors, wedges, and

@@ -54,6 +54,11 @@ from cst_captioning_tpu.telemetry.flops import (  # noqa: F401
     mfu_fields,
     peak_tflops,
 )
+from cst_captioning_tpu.resilience.exitcodes import (
+    EXIT_FAILURE,
+    EXIT_OK,
+)
+from cst_captioning_tpu.resilience.integrity import atomic_json_write
 
 BASELINE_CAPTIONS_PER_SEC = 5000.0
 
@@ -655,8 +660,7 @@ def _emit(result: dict, args) -> None:
                 "steps": args.steps,
                 "config": config, "result": result,
             }
-            with open(TPU_CACHE, "w") as f:
-                json.dump(cache, f, indent=2)
+            atomic_json_write(TPU_CACHE, cache, indent=2)
         except (OSError, ValueError):
             pass
     else:
@@ -978,7 +982,7 @@ def main():
                              f"backend is {plat!r} after "
                              f"{args.probe_retries + 1} probes",
                              probe=probe_info)
-            sys.exit(1)
+            sys.exit(EXIT_FAILURE)
         elif plat == "cpu":
             print("bench: default backend is the host CPU; measuring there",
                   file=sys.stderr)
@@ -1018,7 +1022,7 @@ def main():
             "measurement child produced no JSON "
             + ("(timed out)" if rc == 124 else f"(rc={rc})"),
             probe=probe_info)
-        sys.exit(0 if args.platform == "auto" else 1)
+        sys.exit(EXIT_OK if args.platform == "auto" else EXIT_FAILURE)
     sys.exit(rc)
 
 

@@ -1,0 +1,35 @@
+"""cstlint: project-native static analysis (ANALYSIS.md).
+
+Nine PRs of training/serving hardening produced invariants that lived
+only as prose in RESILIENCE.md/SERVING.md and reviewer memory — never
+fetch device scalars in hot loops, every durable JSON write goes through
+``integrity.atomic_json_write``, every counter is declared-at-0, every
+process exit routes through ``resilience/exitcodes.py``.  Each was
+violated at least once before being fixed by hand.  This package moves
+that enforcement to analysis time: an AST-based rule engine with a rule
+registry, per-rule suppression comments carrying a required written
+justification, JSON + human output, and a jaxpr-level donation audit —
+run over the whole tree as a tier-1 test (tests/test_cstlint.py) so the
+caveats are law, not tribal knowledge.
+
+Entry points: ``scripts/cstlint.py`` / ``make lint`` / ``make lint-json``;
+the rule catalogue and suppression grammar are documented in ANALYSIS.md.
+"""
+
+from .engine import (  # noqa: F401
+    LintResult,
+    Project,
+    RULES,
+    SourceFile,
+    Suppression,
+    Violation,
+    lint_sources,
+    lint_tree,
+    render_human,
+    render_json,
+    tree_files,
+)
+
+# Importing the rule modules registers every shipped rule.
+from . import rules  # noqa: F401,E402
+from . import donation  # noqa: F401,E402

@@ -1,0 +1,259 @@
+"""donation-audit: prove every donated buffer is actually aliased.
+
+``donate_argnums`` is a *request*: XLA only frees the input buffer when
+it can alias it onto an output with a matching shape/dtype.  A donation
+with no matching output is silently skipped (one warning, easy to lose),
+so "state is updated in place" claims rot the moment a step stops
+returning the state — exactly what PR 3 and PR 6 audited BY HAND across
+trainer/bench/serving.  This module mechanizes that audit at lowering
+time, no device execution:
+
+- every donated-entry-point family registers a builder here (trainer XE
+  step, fused device-reward CST step, serving greedy/beam chunk + admit
+  programs) that constructs the REAL jitted program at tiny shapes and
+  returns its ``jax.stages.Lowered`` plus the donated-leaf count;
+- :func:`audit_lowered` parses the lowered StableHLO entry signature:
+  jax marks each donated-and-aliased input with ``tf.aliasing_output``
+  (a donated-but-unusable input gets no marker), so
+  ``aliased == donated leaves`` is the machine-checkable form of the
+  hand audit.
+
+The rule is registered with ``needs_trace=True``: AST-only runs
+(``cstlint --no-trace``) skip it; ``make lint`` and the tier-1 test run
+it against every registered entry point.
+"""
+
+from __future__ import annotations
+
+import inspect
+import re
+from typing import Callable, Dict, Iterator, List, Tuple
+
+from .engine import Project, Violation, rule
+
+#: name -> builder() -> (jax.stages.Lowered, donated_leaf_count).
+ENTRY_POINTS: Dict[str, Callable] = {}
+
+
+def register_entry_point(name: str):
+    """Decorator adding a donated jit program to the audited registry."""
+
+    def deco(fn):
+        ENTRY_POINTS[name] = fn
+        return fn
+
+    return deco
+
+
+_ALIAS_RE = re.compile(r"tf\.aliasing_output")
+
+
+def _main_signature(text: str) -> str:
+    """The @main argument list of a lowered StableHLO module — from
+    'func.func public @main(' to the '->' result arrow (arg attribute
+    blocks like '{tf.aliasing_output = 0 : i32}' live in between; result
+    attributes come after the arrow and must not be counted)."""
+    start = text.find("@main(")
+    if start < 0:
+        return ""
+    end = text.find("->", start)
+    if end < 0:
+        end = text.find("\n", start)
+    return text[start:end if end > 0 else len(text)]
+
+
+def audit_lowered(lowered, donated_leaves: int) -> List[str]:
+    """-> problems (empty = every donated leaf aliased to an output)."""
+    sig = _main_signature(lowered.as_text())
+    if not sig:
+        return ["could not locate @main in the lowered module "
+                "(jax lowering format changed?)"]
+    aliased = len(_ALIAS_RE.findall(sig))
+    if aliased < donated_leaves:
+        return [f"only {aliased} of {donated_leaves} donated leaves are "
+                "aliased to outputs — the rest are silently NOT freed "
+                "(XLA skips unusable donations with a warning)"]
+    if donated_leaves == 0:
+        return ["entry point declares zero donated leaves — register it "
+                "without donation auditing or fix the builder"]
+    return []
+
+
+def audit_entry_points(entry_points: Dict[str, Callable] = None
+                       ) -> Dict[str, List[str]]:
+    """Run every registered builder; -> {name: [problems]} (empty lists
+    for clean entries).  Builder exceptions are reported as problems,
+    not raised — one broken entry must not mask the others' results."""
+    out: Dict[str, List[str]] = {}
+    for name, builder in sorted((entry_points or ENTRY_POINTS).items()):
+        try:
+            lowered, donated = builder()
+            out[name] = audit_lowered(lowered, donated)
+        except Exception as e:  # surfaced as a violation, not a crash
+            out[name] = [f"entry-point builder failed: {e!r}"]
+    return out
+
+
+# -- registered entry points -------------------------------------------------
+# Tiny-shape twins of the real programs, built through the SAME factories
+# the trainer/serving engine use (make_xe_step / make_fused_cst_step /
+# data_parallel_jit / ServingEngine._programs) so a donation regression in
+# any factory fails the audit before a chip ever runs it.
+
+_V, _H, _B, _S, _L = 20, 8, 2, 2, 5
+_FEAT_SHAPES = [(3, 4)]
+
+
+def _tiny_model_state():
+    import jax
+    import numpy as np
+
+    from ..models import CaptionModel
+    from ..training.state import create_train_state, make_optimizer
+
+    model = CaptionModel(vocab_size=_V, embed_size=_H, hidden_size=_H,
+                         attn_size=_H, dropout_rate=0.0)
+    tx, _ = make_optimizer(learning_rate=1e-3, grad_clip=5.0)
+    state = create_train_state(model, jax.random.PRNGKey(0), _FEAT_SHAPES,
+                               _L, _S, tx, batch_size=_B)
+    rng = np.random.default_rng(0)
+    feats = [rng.standard_normal((_B,) + s).astype(np.float32)
+             for s in _FEAT_SHAPES]
+    return model, state, feats
+
+
+@register_entry_point("trainer_xe_dp_step")
+def _xe_dp_step():
+    """The trainer's XE train step through data_parallel_jit, state
+    donated (trainer.py --> parallel/dp.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import data_parallel_jit, make_mesh
+    from ..training.steps import make_xe_step
+    import numpy as np
+
+    model, state, feats = _tiny_model_state()
+    mesh = make_mesh(jax.devices()[:1])
+    step = data_parallel_jit(make_xe_step(model, _S), mesh,
+                             batch_argnums=(1, 2, 3), donate_argnums=(0,))
+    rng = np.random.default_rng(1)
+    labels = jnp.asarray(rng.integers(1, _V, (_B * _S, _L)), jnp.int32)
+    weights = jnp.ones((_B * _S,), jnp.float32)
+    args = (state, [jnp.asarray(f) for f in feats], labels, weights,
+            jax.random.PRNGKey(1))
+    lowered = step.jit_for(len(args)).lower(*args)
+    return lowered, len(jax.tree_util.tree_leaves(state))
+
+
+@register_entry_point("trainer_fused_cst_dp_step")
+def _fused_cst_dp_step():
+    """The fused device-reward CST step (--device_rewards 1, the shipped
+    RL path), state donated."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import data_parallel_jit, make_mesh
+    from ..training.device_rewards import build_device_tables
+    from ..training.steps import make_fused_cst_step
+
+    model, state, feats = _tiny_model_state()
+    vocab_words = {i: f"w{i}" for i in range(1, _V)}
+    w2i = {w: i for i, w in vocab_words.items()}
+    refs = {f"v{i}": [" ".join(f"w{1 + ((i + j + k) % (_V - 1))}"
+                              for k in range(4)) for j in range(2)]
+            for i in range(3)}
+    corpus, tables, video_row = build_device_tables(refs, w2i)
+    fused = make_fused_cst_step(model, _L, _S, corpus, tables)
+    mesh = make_mesh(jax.devices()[:1])
+    step = data_parallel_jit(fused, mesh, batch_argnums=(1, 2),
+                             donate_argnums=(0,))
+    vix = jnp.asarray([video_row[f"v{i % 3}"] for i in range(_B)],
+                      jnp.int32)
+    args = (state, [jnp.asarray(f) for f in feats], vix,
+            jax.random.PRNGKey(1))
+    lowered = step.jit_for(len(args)).lower(*args)
+    return lowered, len(jax.tree_util.tree_leaves(state))
+
+
+def _serving_programs(beam_size: int):
+    import jax
+    import numpy as np
+
+    from ..models import CaptionModel
+    from ..serving.engine import ServingEngine
+
+    model = CaptionModel(vocab_size=_V, embed_size=_H, hidden_size=_H,
+                         attn_size=_H, dropout_rate=0.0)
+    t, d = _FEAT_SHAPES[0]
+    feats = [np.zeros((1, t, d), np.float32)]
+    variables = model.init(jax.random.PRNGKey(0),
+                           [jax.numpy.asarray(feats[0])],
+                           np.zeros((1, _L), np.int32))
+    engine = ServingEngine(model, variables, [(t, d)], max_len=_L,
+                           beam_size=beam_size, decode_chunk=2,
+                           bucket_sizes=(2,))
+    slots = 2
+    programs = engine._programs(slots)
+    state = engine._init_state(slots)
+    return engine, variables, programs, state, feats
+
+
+def _serving_entry(beam_size: int, which: str):
+    import jax
+    import jax.numpy as jnp
+
+    engine, variables, programs, state, feats = \
+        _serving_programs(beam_size)
+    donated = len(jax.tree_util.tree_leaves(state))
+    if which == "chunk":
+        lowered = programs["chunk"].lower(variables, state)
+    else:
+        lowered = programs["admit"].lower(
+            variables, state, [jnp.asarray(feats[0])], jnp.int32(0))
+    return lowered, donated
+
+
+@register_entry_point("serving_greedy_chunk")
+def _serve_greedy_chunk():
+    """ServingEngine's compiled greedy decode chunk, slot state donated."""
+    return _serving_entry(1, "chunk")
+
+
+@register_entry_point("serving_greedy_admit")
+def _serve_greedy_admit():
+    """ServingEngine's one-encoder-pass admission program (greedy)."""
+    return _serving_entry(1, "admit")
+
+
+@register_entry_point("serving_beam_chunk")
+def _serve_beam_chunk():
+    """ServingEngine's compiled beam decode chunk, slot state donated."""
+    return _serving_entry(3, "chunk")
+
+
+@register_entry_point("serving_beam_admit")
+def _serve_beam_admit():
+    """ServingEngine's admission program under beam decoding."""
+    return _serving_entry(3, "admit")
+
+
+# -- the rule ----------------------------------------------------------------
+
+@rule("donation-audit",
+      "every donate_argnames/donate_argnums leaf of the registered jit "
+      "entry points is aliased to an output at lowering time",
+      needs_trace=True)
+def check_donation(project: Project) -> Iterator[Violation]:
+    for name, problems in audit_entry_points().items():
+        builder = ENTRY_POINTS[name]
+        try:
+            src = inspect.getsourcefile(builder) or ""
+            line = inspect.getsourcelines(builder)[1]
+        except (OSError, TypeError):
+            src, line = "", 1
+        rel = "cst_captioning_tpu/analysis/donation.py" \
+            if src.endswith("donation.py") else (src or "<donation>")
+        for p in problems:
+            yield Violation("donation-audit", rel, line, 0,
+                            f"entry point '{name}': {p}")

@@ -1,0 +1,370 @@
+"""cstlint rule engine: sources, suppressions, registry, runner, output.
+
+The engine is deliberately small and dependency-free (stdlib ``ast`` +
+``tokenize``); jax is imported only by the donation-audit rule, and only
+when tracing is enabled for the run.  Rules are registered by name and
+checked against a :class:`Project` (every source file parsed once); each
+raw finding then passes through the suppression layer:
+
+- ``# cstlint: disable=<rule>[,<rule>...] -- <justification>`` suppresses
+  the named rule(s) on the comment's own line (trailing comment) or on
+  the next non-blank, non-comment line (standalone comment).
+- The justification text after ``--`` is REQUIRED: a suppression without
+  one is itself a violation (``suppression-format``) and does not apply.
+- A suppression that no longer matches any raw finding of its rule is
+  reported as ``stale-suppression`` (only for rules that actually ran,
+  so a ``--rules`` subset can never mass-expire the others' receipts) —
+  justified exceptions cannot rot silently.
+
+Meta rules (``parse-error``, ``suppression-format``,
+``stale-suppression``) are engine-owned and cannot be suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Engine/format version stamped into the JSON report.
+LINT_SCHEMA = 1
+
+#: Rule registry: name -> Rule.  Populated by the @rule decorator at
+#: import time (analysis.rules / analysis.donation).
+RULES: Dict[str, "Rule"] = {}
+
+#: Engine-owned finding kinds; never suppressible, always reported.
+META_RULES = ("parse-error", "suppression-format", "stale-suppression")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cstlint:\s*disable=([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"\s*(?:--\s*(.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding, anchored to a source line."""
+
+    rule: str
+    path: str          # repo-relative path (or the virtual path in tests)
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+
+@dataclass
+class Suppression:
+    """One parsed ``cstlint: disable`` comment."""
+
+    rules: Tuple[str, ...]
+    path: str
+    comment_line: int      # line the comment sits on
+    target_line: int       # line the suppression applies to
+    justification: str     # "" when missing (-> suppression-format)
+    used_rules: set = field(default_factory=set)
+
+
+class Rule:
+    """A registered check.  ``check(project)`` yields raw Violations;
+    the engine applies suppressions afterwards."""
+
+    def __init__(self, name: str, doc: str,
+                 check: Callable[["Project"], Iterable[Violation]],
+                 needs_trace: bool = False):
+        self.name = name
+        self.doc = doc
+        self._check = check
+        #: True for rules that trace/lower jax programs (donation-audit);
+        #: skipped when the run disables tracing.
+        self.needs_trace = needs_trace
+
+    def check(self, project: "Project") -> Iterable[Violation]:
+        return self._check(project)
+
+
+def rule(name: str, doc: str, needs_trace: bool = False):
+    """Decorator registering a check function under ``name``."""
+    if name in META_RULES:
+        raise ValueError(f"{name!r} is reserved for the engine")
+
+    def deco(fn):
+        RULES[name] = Rule(name, doc, fn, needs_trace=needs_trace)
+        return fn
+
+    return deco
+
+
+class SourceFile:
+    """One parsed source: AST + comments + suppression table."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.suppressions: List[Suppression] = self._scan_suppressions()
+
+    @classmethod
+    def from_path(cls, path: str, relpath: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            return cls(relpath, f.read())
+
+    # -- suppression comments ---------------------------------------------
+
+    def _scan_suppressions(self) -> List[Suppression]:
+        out: List[Suppression] = []
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return out
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            names = tuple(r.strip() for r in m.group(1).split(","))
+            line = tok.start[0]
+            standalone = not self.lines[line - 1][:tok.start[1]].strip()
+            target = self._next_code_line(line) if standalone else line
+            out.append(Suppression(
+                rules=names, path=self.relpath, comment_line=line,
+                target_line=target, justification=m.group(2) or ""))
+        return out
+
+    def _next_code_line(self, after: int) -> int:
+        """First non-blank, non-comment line after ``after`` (1-based);
+        the line a standalone suppression comment governs."""
+        for i in range(after, len(self.lines)):
+            stripped = self.lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+        return after  # trailing comment at EOF: govern itself (no-op)
+
+    def statement_span(self, line: int) -> Tuple[int, int]:
+        """(first, last) physical line of the statement STARTING at
+        ``line`` — a suppression governs the whole statement, so a
+        multi-line call chain needs one comment, not one per line."""
+        if self.tree is None:
+            return line, line
+        end = line
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.stmt) and node.lineno == line:
+                # The outermost statement starting here wins (`if` arms
+                # start at their test line, not here).
+                body_start = min(
+                    (s.lineno for s in ast.iter_child_nodes(node)
+                     if isinstance(s, ast.stmt)), default=None)
+                stop = node.end_lineno or line
+                if body_start is not None and body_start > line:
+                    stop = min(stop, body_start - 1)
+                end = max(end, stop)
+        return line, end
+
+
+class Project:
+    """Every source file of one lint run, plus run configuration."""
+
+    def __init__(self, files: Sequence[SourceFile], root: str = "",
+                 trace: bool = True):
+        self.files = list(files)
+        self.root = root
+        self.trace = trace
+        self.by_path = {f.relpath: f for f in self.files}
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        return self.by_path.get(relpath)
+
+
+@dataclass
+class LintResult:
+    """Outcome of one run.  ``violations`` includes the meta findings
+    (stale/format/parse); ``clean`` is the ``make lint`` gate."""
+
+    violations: List[Violation]
+    suppressed: List[Tuple[Violation, Suppression]]
+    rules_ran: List[str]
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.violations:
+            out[v.rule] = out.get(v.rule, 0) + 1
+        return out
+
+
+# -- tree discovery ----------------------------------------------------------
+
+#: Directories walked relative to the repo root, plus top-level ``*.py``
+#: entry points.  tests/ is deliberately out of scope (the seeded
+#: violation corpus lives there), matching ISSUE 10's enforcement
+#: surface: the package, the scripts, and the CLIs.
+TREE_ROOTS = ("cst_captioning_tpu", "scripts")
+_EXCLUDE_DIRS = ("__pycache__",)
+
+
+def tree_files(root: str) -> List[str]:
+    """Repo-relative paths of every linted source file under ``root``."""
+    out: List[str] = []
+    for sub in TREE_ROOTS:
+        base = os.path.join(root, sub)
+        for path in sorted(glob.glob(os.path.join(base, "**", "*.py"),
+                                     recursive=True)):
+            rel = os.path.relpath(path, root)
+            if any(part in _EXCLUDE_DIRS for part in rel.split(os.sep)):
+                continue
+            out.append(rel)
+    out.extend(sorted(
+        os.path.relpath(p, root)
+        for p in glob.glob(os.path.join(root, "*.py"))))
+    return out
+
+
+# -- the runner --------------------------------------------------------------
+
+def _resolve_rules(names: Optional[Sequence[str]]) -> List[Rule]:
+    if names is None:
+        return [RULES[k] for k in sorted(RULES)]
+    missing = [n for n in names if n not in RULES]
+    if missing:
+        raise KeyError(f"unknown rule(s): {', '.join(missing)}; "
+                       f"known: {', '.join(sorted(RULES))}")
+    return [RULES[n] for n in names]
+
+
+def run_rules(project: Project,
+              rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Check ``project``, apply suppressions, report stale ones."""
+    selected = [r for r in _resolve_rules(rules)
+                if project.trace or not r.needs_trace]
+    ran = [r.name for r in selected]
+
+    raw: List[Violation] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            raw.append(Violation("parse-error", f.relpath, 1, 0,
+                                 f"cannot parse: {f.parse_error}"))
+    for r in selected:
+        raw.extend(r.check(project))
+
+    # Index suppressions by (path, rule) -> [(span, suppression)]; a
+    # suppression governs the whole statement at its target line, and is
+    # pre-flagged when the grammar lacks the required justification.
+    visible: List[Violation] = []
+    suppressed: List[Tuple[Violation, Suppression]] = []
+    sup_index: Dict[Tuple[str, str],
+                    List[Tuple[Tuple[int, int], Suppression]]] = {}
+    for f in project.files:
+        for s in f.suppressions:
+            if not s.justification:
+                visible.append(Violation(
+                    "suppression-format", s.path, s.comment_line, 0,
+                    "suppression lacks a justification — write "
+                    "'# cstlint: disable=<rule> -- <why this is safe>'"))
+                continue  # an unjustified suppression does not apply
+            span = f.statement_span(s.target_line)
+            for name in s.rules:
+                sup_index.setdefault((s.path, name), []).append((span, s))
+
+    for v in raw:
+        match = None
+        if v.rule not in META_RULES:
+            for (lo, hi), s in sup_index.get((v.path, v.rule), ()):
+                if lo <= v.line <= hi:
+                    match = s
+                    break
+        if match is not None:
+            match.used_rules.add(v.rule)
+            suppressed.append((v, match))
+        else:
+            visible.append(v)
+
+    ran_set = set(ran)
+    for f in project.files:
+        for s in f.suppressions:
+            if not s.justification:
+                continue
+            for name in s.rules:
+                if name in ran_set and name not in s.used_rules:
+                    visible.append(Violation(
+                        "stale-suppression", s.path, s.comment_line, 0,
+                        f"'{name}' no longer fires on line "
+                        f"{s.target_line} — remove the suppression "
+                        f"(justification was: {s.justification})"))
+
+    visible.sort(key=lambda v: (v.path, v.line, v.rule))
+    return LintResult(violations=visible, suppressed=suppressed,
+                      rules_ran=ran, files_scanned=len(project.files))
+
+
+def lint_sources(sources: Sequence[Tuple[str, str]],
+                 rules: Optional[Sequence[str]] = None,
+                 trace: bool = False) -> LintResult:
+    """Lint in-memory (relpath, text) pairs — the corpus-test surface."""
+    project = Project([SourceFile(rel, text) for rel, text in sources],
+                      trace=trace)
+    return run_rules(project, rules=rules)
+
+
+def lint_tree(root: str, rules: Optional[Sequence[str]] = None,
+              trace: bool = True,
+              paths: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint the repo tree (or an explicit repo-relative ``paths`` list)."""
+    rels = list(paths) if paths else tree_files(root)
+    files = [SourceFile.from_path(os.path.join(root, rel), rel)
+             for rel in rels]
+    return run_rules(Project(files, root=root, trace=trace), rules=rules)
+
+
+# -- output ------------------------------------------------------------------
+
+def render_human(result: LintResult) -> str:
+    lines = [v.render() for v in result.violations]
+    counts = result.summary()
+    if counts:
+        per_rule = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        lines.append(f"cstlint: {len(result.violations)} violation(s) "
+                     f"[{per_rule}] in {result.files_scanned} file(s)")
+    else:
+        lines.append(
+            f"cstlint: clean — {result.files_scanned} file(s), "
+            f"{len(result.rules_ran)} rule(s), "
+            f"{len(result.suppressed)} justified suppression(s)")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    doc = {
+        "schema": LINT_SCHEMA,
+        "clean": result.clean,
+        "files_scanned": result.files_scanned,
+        "rules_ran": result.rules_ran,
+        "summary": result.summary(),
+        "violations": [vars(v) for v in result.violations],
+        "suppressed": [
+            {**vars(v), "justification": s.justification,
+             "comment_line": s.comment_line}
+            for v, s in result.suppressed
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

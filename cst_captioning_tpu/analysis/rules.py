@@ -1,0 +1,382 @@
+"""The shipped AST rules — each one a RESILIENCE.md/SERVING.md invariant
+distilled from PRs 1-9 (catalogue + rationale: ANALYSIS.md).
+
+All five are static heuristics, tuned against this tree: where the AST
+cannot prove a value is host-side (Python has no types here), the rule
+errs toward flagging inside the configured hot paths and the call site
+carries a justified suppression instead — the suppression text IS the
+documentation the old hand-audits never left behind.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
+
+from .engine import Project, SourceFile, Violation, rule
+
+# ---------------------------------------------------------------------------
+# device-scalar-fetch
+# ---------------------------------------------------------------------------
+
+#: Hot-path files/dirs where a per-iteration device scalar fetch is the
+#: exact pattern this environment's native stack nondeterministically
+#: garbles to 0.0 (RESILIENCE.md caveat; PR 3 moved the trainer's control
+#: plane to host-side integers, PR 8 batched dryrun's fetches).
+HOT_PATHS = (
+    "cst_captioning_tpu/training/trainer.py",
+    "cst_captioning_tpu/training/pipeline.py",
+    "cst_captioning_tpu/training/rewards.py",
+    "cst_captioning_tpu/serving/engine.py",
+    "cst_captioning_tpu/serving/server.py",
+    "cst_captioning_tpu/parallel/",
+)
+
+#: Conversions that force a device->host sync when applied to a jax
+#: array.  ``.item()`` and ``jax.device_get`` are always fetches;
+#: float/int/np.asarray only when their argument isn't provably host.
+_FETCH_NAMES = {"float", "int"}
+
+
+def _is_hot(relpath: str) -> bool:
+    return any(relpath == p or (p.endswith("/") and relpath.startswith(p))
+               for p in HOT_PATHS)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'np.asarray' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _host_safe(node: ast.AST) -> bool:
+    """Conservatively true when the expression cannot be a jax array:
+    literals, len()/range()/time.* results, ``.shape`` lookups, and
+    arithmetic/comparisons built from those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in ("len", "range", "ord", "str", "repr", "id") or \
+                name.startswith("time.") or name.startswith("os."):
+            return True
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim",
+                                                         "size", "dtype"):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _host_safe(node.value)
+    if isinstance(node, ast.BinOp):
+        return _host_safe(node.left) and _host_safe(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _host_safe(node.operand)
+    if isinstance(node, ast.BoolOp):
+        return all(_host_safe(v) for v in node.values)
+    return False
+
+
+class _LoopFetchVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.depth = 0
+        self.hits: List[Violation] = []
+
+    def _loop(self, node):
+        self.depth += 1
+        self.generic_visit(node)
+        self.depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_Call(self, node: ast.Call):
+        if self.depth > 0:
+            name = _dotted(node.func)
+            msg = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                msg = ".item() fetches a device scalar"
+            elif name in _FETCH_NAMES and len(node.args) == 1 and \
+                    not _host_safe(node.args[0]):
+                msg = f"{name}() on a possibly-device value forces a sync"
+            elif name in ("np.asarray", "numpy.asarray", "onp.asarray") \
+                    and node.args and not _host_safe(node.args[0]):
+                msg = f"{name}() on a possibly-device value forces a copy"
+            elif name in ("jax.device_get", "jax.block_until_ready"):
+                msg = f"{name}() inside a loop body"
+            if msg is not None:
+                self.hits.append(Violation(
+                    "device-scalar-fetch", self.relpath, node.lineno,
+                    node.col_offset,
+                    msg + " inside a hot-path loop — keep values on "
+                    "device and batch one fetch after the loop (the "
+                    "native stack garbles per-step scalar fetches; "
+                    "RESILIENCE.md caveat)"))
+        self.generic_visit(node)
+
+
+@rule("device-scalar-fetch",
+      "no per-iteration device scalar fetches (float/int/.item()/"
+      "np.asarray/device_get) in trainer/engine/parallel hot-path loops")
+def check_device_scalar_fetch(project: Project) -> Iterator[Violation]:
+    for f in project.files:
+        if f.tree is None or not _is_hot(f.relpath):
+            continue
+        v = _LoopFetchVisitor(f.relpath)
+        v.visit(f.tree)
+        yield from v.hits
+
+
+# ---------------------------------------------------------------------------
+# atomic-write
+# ---------------------------------------------------------------------------
+
+#: The one module allowed to spell the raw write (it IS the discipline).
+_ATOMIC_HOME = "cst_captioning_tpu/resilience/integrity.py"
+
+
+def _json_path_expr(node: ast.AST) -> bool:
+    """Does this expression syntactically look like a *.json/*.jsonl
+    path?  Literal suffixes, f-string tails, os.path.join tails, and
+    name hints ('...json...') — heuristic by design; a false negative
+    is caught when the write grows a literal suffix."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.endswith((".json", ".jsonl"))
+    if isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        return isinstance(last, ast.Constant) and \
+            isinstance(last.value, str) and \
+            last.value.endswith((".json", ".jsonl"))
+    if isinstance(node, ast.Call) and \
+            _dotted(node.func) in ("os.path.join", "posixpath.join") and \
+            node.args:
+        return _json_path_expr(node.args[-1])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _json_path_expr(node.right)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        tail = node.id if isinstance(node, ast.Name) else node.attr
+        return "json" in tail.lower()
+    return False
+
+
+def _open_mode(node: ast.Call) -> Optional[ast.AST]:
+    """The mode expression of an ``open()`` call — positional arg 1 or
+    the ``mode=`` keyword (both spellings must be caught)."""
+    if len(node.args) >= 2:
+        return node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            return kw.value
+    return None
+
+
+def _is_text_write_mode(mode: Optional[ast.AST]) -> bool:
+    return (isinstance(mode, ast.Constant) and
+            isinstance(mode.value, str) and
+            "w" in mode.value and "b" not in mode.value)
+
+
+@rule("atomic-write",
+      "durable *.json/*.jsonl writes must go through "
+      "integrity.atomic_json_write (fsync'd tmp + rename + dir fsync)")
+def check_atomic_write(project: Project) -> Iterator[Violation]:
+    for f in project.files:
+        if f.tree is None or f.relpath == _ATOMIC_HOME:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name == "json.dump":
+                yield Violation(
+                    "atomic-write", f.relpath, node.lineno,
+                    node.col_offset,
+                    "json.dump to a raw handle can be torn by a crash — "
+                    "route durable JSON through "
+                    "resilience.integrity.atomic_json_write")
+            elif name == "open" and node.args and \
+                    _is_text_write_mode(_open_mode(node)) and \
+                    _json_path_expr(node.args[0]):
+                yield Violation(
+                    "atomic-write", f.relpath, node.lineno,
+                    node.col_offset,
+                    "open(<*.json path>, 'w') bypasses the atomic-write "
+                    "discipline — use "
+                    "resilience.integrity.atomic_json_write")
+
+
+# ---------------------------------------------------------------------------
+# declared-counters
+# ---------------------------------------------------------------------------
+
+def _counter_sites(f: SourceFile):
+    """-> (declared names, [(inc name, lineno, col)]) for one file."""
+    declared: Set[str] = set()
+    incs: List[Tuple[str, int, int]] = []
+    if f.tree is None:
+        return declared, incs
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Assign):
+            # A *COUNTERS*-named table of string literals IS a declare
+            # site (engine.COUNTERS is splat into registry.declare at
+            # attach time; the SERVING.md doc table is pinned to it), so
+            # `declare(*COUNTERS)` needs no separate starred resolution.
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and "COUNTERS" in tgt.id and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    declared.update(e.value for e in node.value.elts
+                                    if isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str))
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if attr == "declare":
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    declared.add(a.value)
+        elif attr in ("inc", "_inc") and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            incs.append((node.args[0].value, node.lineno, node.col_offset))
+    return declared, incs
+
+
+@rule("declared-counters",
+      "every literal counter increment has a declare-at-0 site "
+      "(registry.declare / a COUNTERS table) somewhere in the tree")
+def check_declared_counters(project: Project) -> Iterator[Violation]:
+    declared: Set[str] = set()
+    per_file = []
+    for f in project.files:
+        d, incs = _counter_sites(f)
+        declared |= d
+        per_file.append((f, incs))
+    for f, incs in per_file:
+        for name, line, col in incs:
+            if name not in declared:
+                yield Violation(
+                    "declared-counters", f.relpath, line, col,
+                    f"counter '{name}' is incremented but never declared "
+                    "at 0 — add it to the owner's registry.declare()/"
+                    "COUNTERS table so snapshots distinguish 'armed, "
+                    "nothing happened' from 'feature absent'")
+
+
+# ---------------------------------------------------------------------------
+# exit-taxonomy
+# ---------------------------------------------------------------------------
+
+_EXIT_HOME = "cst_captioning_tpu/resilience/exitcodes.py"
+
+
+def _int_literal(node: Optional[ast.AST]) -> bool:
+    """True for int literals including the negative spelling
+    ``sys.exit(-1)`` (ast.UnaryOp(USub) around the Constant)."""
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return (isinstance(node, ast.Constant) and
+            isinstance(node.value, int) and
+            not isinstance(node.value, bool))
+
+
+@rule("exit-taxonomy",
+      "process exits spell a resilience.exitcodes constant, never a "
+      "bare int literal (and never a string: that exits 1 untyped)")
+def check_exit_taxonomy(project: Project) -> Iterator[Violation]:
+    for f in project.files:
+        if f.tree is None or f.relpath == _EXIT_HOME:
+            continue
+        for node in ast.walk(f.tree):
+            arg = None
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) in ("sys.exit", "exit", "os._exit"):
+                arg = node.args[0] if node.args else None
+            elif isinstance(node, ast.Raise) and \
+                    isinstance(node.exc, ast.Call) and \
+                    _dotted(node.exc.func) == "SystemExit":
+                arg = node.exc.args[0] if node.exc.args else None
+            else:
+                continue
+            if _int_literal(arg):
+                yield Violation(
+                    "exit-taxonomy", f.relpath, node.lineno,
+                    node.col_offset,
+                    "exit with a bare int literal — name it via "
+                    "resilience.exitcodes (EXIT_*) so "
+                    "scale_chain.classify() can route the death")
+            elif isinstance(arg, ast.JoinedStr) or (
+                    isinstance(arg, ast.Constant) and
+                    isinstance(arg.value, str)):
+                yield Violation(
+                    "exit-taxonomy", f.relpath, node.lineno,
+                    node.col_offset,
+                    "sys.exit(<string>) exits 1 with the message on "
+                    "stderr, bypassing the taxonomy — use parser.error() "
+                    "(usage, EXIT_USAGE) or print + an EXIT_* constant")
+            elif isinstance(arg, ast.IfExp) and any(
+                    _int_literal(b) for b in (arg.body, arg.orelse)):
+                yield Violation(
+                    "exit-taxonomy", f.relpath, node.lineno,
+                    node.col_offset,
+                    "exit with conditional int literals — name both "
+                    "branches via resilience.exitcodes (EXIT_*)")
+
+
+# ---------------------------------------------------------------------------
+# bare-except-swallow
+# ---------------------------------------------------------------------------
+
+#: Failure-domain code where a silently swallowed exception is itself a
+#: fault: one bad line/chunk must be COUNTED (PR 9's serving contract).
+_SWALLOW_SCOPE = ("cst_captioning_tpu/serving/",
+                  "cst_captioning_tpu/resilience/")
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(isinstance(n, ast.Name) and
+               n.id in ("Exception", "BaseException") for n in names)
+
+
+def _body_accounts(handler: ast.ExceptHandler) -> bool:
+    """True when the handler does ANYTHING observable — a log call, a
+    counter increment, a re-raise, an assignment.  Only a body that is
+    entirely pass/docstring swallows silently."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue
+        return True
+    return False
+
+
+@rule("bare-except-swallow",
+      "serving/resilience code may not swallow Exception silently — "
+      "count it or log it (one bad line must be visible, PR 9)")
+def check_bare_except_swallow(project: Project) -> Iterator[Violation]:
+    for f in project.files:
+        if f.tree is None or \
+                not any(f.relpath.startswith(p) for p in _SWALLOW_SCOPE):
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ExceptHandler) and \
+                    _broad_handler(node) and not _body_accounts(node):
+                yield Violation(
+                    "bare-except-swallow", f.relpath, node.lineno,
+                    node.col_offset,
+                    "broad except swallows silently in failure-domain "
+                    "code — increment a counter or log before "
+                    "continuing (a fault nobody counted never happened)")

@@ -22,6 +22,8 @@ import json
 from collections import defaultdict
 from typing import Dict, List, Mapping, Optional, Sequence
 
+from ..resilience.integrity import atomic_json_write
+
 Annotations = List[dict]
 
 
@@ -140,8 +142,7 @@ def main(argv=None):
         if not anns:
             continue
         path = f"{args.out_prefix}{split}_anns.json"
-        with open(path, "w") as f:
-            json.dump({"videos": anns}, f)
+        atomic_json_write(path, {"videos": anns})
         written[split] = path
     print(json.dumps(written, indent=2))
     return written

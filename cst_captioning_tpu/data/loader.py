@@ -267,6 +267,10 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
     stop = object()
     closed = threading.Event()  # consumer gone: worker must drop its buffers
     tracer = telemetry.tracer if telemetry is not None else None
+    if telemetry is not None:
+        # Declared at 0 at prefetch start (cstlint:declared-counters):
+        # 0 in the snapshot means the retry path was armed and unused.
+        telemetry.declare("loader_retries")
 
     next_batch = getattr(batches, "next_batch", None)
     if next_batch is None:

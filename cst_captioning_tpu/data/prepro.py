@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 import h5py
 import numpy as np
 
+from ..resilience.integrity import atomic_json_write
 from ..metrics import (
     build_corpus_df,
     compute_consensus_scores,
@@ -74,9 +75,9 @@ def build_split(
     paths["vocab_json"] = vocab_path
 
     info_path = os.path.join(out_dir, f"{split}_info.json")
-    with open(info_path, "w") as f:
-        json.dump({"ix_to_word": vocab.to_json(),
-                   "videos": [{"id": v} for v in video_ids]}, f)
+    atomic_json_write(info_path,
+                      {"ix_to_word": vocab.to_json(),
+                       "videos": [{"id": v} for v in video_ids]})
     paths["info_json"] = info_path
 
     rows, starts, ends = [], [], []
@@ -92,15 +93,14 @@ def build_split(
     paths["label_h5"] = label_path
 
     coco_path = os.path.join(out_dir, f"{split}_cocofmt.json")
-    with open(coco_path, "w") as f:
-        json.dump({
-            "images": [{"id": v} for v in video_ids],
-            "annotations": [
-                {"image_id": vid, "id": f"{vid}#{j}", "caption": c}
-                for vid, caps in zip(video_ids, raw_caps)
-                for j, c in enumerate(caps)
-            ],
-        }, f)
+    atomic_json_write(coco_path, {
+        "images": [{"id": v} for v in video_ids],
+        "annotations": [
+            {"image_id": vid, "id": f"{vid}#{j}", "caption": c}
+            for vid, caps in zip(video_ids, raw_caps)
+            for j, c in enumerate(caps)
+        ],
+    })
     paths["cocofmt_json"] = coco_path
 
     if build_reward_artifacts:

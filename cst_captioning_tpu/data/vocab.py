@@ -21,6 +21,8 @@ from typing import Dict, Iterable, List, Mapping, Sequence
 
 import numpy as np
 
+from ..resilience.integrity import atomic_json_write
+
 PAD_EOS = 0  # id 0: padding, end-of-sequence, and the decoder's BOS input
 UNK_TOKEN = "<unk>"
 
@@ -97,8 +99,9 @@ def build_vocab(
 
 
 def save_vocab(path: str, vocab: Vocab) -> None:
-    with open(path, "w") as f:
-        json.dump({"ix_to_word": vocab.to_json()}, f)
+    # Dataset artifacts are durable: a torn vocab json would poison every
+    # later stage that loads it (atomic-write discipline, ANALYSIS.md).
+    atomic_json_write(path, {"ix_to_word": vocab.to_json()})
 
 
 def load_vocab(path: str) -> Vocab:

@@ -73,18 +73,27 @@ def data_parallel_jit(
 
     compiled = {}
 
-    def wrapped(*args):
-        fn = compiled.get(len(args))
+    def jit_for(nargs: int):
+        """The underlying ``jax.jit`` object for an ``nargs``-argument
+        call — exposed so the donation audit
+        (``analysis/donation.py``) can ``.lower()`` the REAL program and
+        verify every donated leaf aliases an output, instead of
+        re-deriving the sharding/donation spec by hand."""
+        fn = compiled.get(nargs)
         if fn is None:
             fn = jax.jit(
                 step_fn,
-                in_shardings=in_sh(len(args)),
+                in_shardings=in_sh(nargs),
                 out_shardings=out_sh,
-                donate_argnums=tuple(i for i in donated if i < len(args)),
+                donate_argnums=tuple(i for i in donated if i < nargs),
             )
-            compiled[len(args)] = fn
-        return fn(*args)
+            compiled[nargs] = fn
+        return fn
 
+    def wrapped(*args):
+        return jit_for(len(args))(*args)
+
+    wrapped.jit_for = jit_for
     return wrapped
 
 

@@ -127,6 +127,10 @@ class FaultPlan:
         (``fault_firings`` total + ``fault_<kind>`` per kind) so a chaos
         drill's injections are auditable in the exit telemetry.json."""
         self._metrics = registry
+        # Declared at 0 per armed kind: a drill's snapshot shows which
+        # faults were LOADED, not only which fired.
+        registry.declare("fault_firings",
+                         *(f"fault_{s.kind}" for s in self.specs))
         return self
 
     def bind_state(self, path: str) -> "FaultPlan":

@@ -47,6 +47,11 @@ class DivergenceGuard:
         self.max_rollbacks = max(0, int(max_rollbacks))
         self.lag = max(0, int(lag))
         self._metrics = metrics
+        if metrics is not None:
+            # Declared at 0 at arm time (cstlint:declared-counters): an
+            # exit snapshot with 0 trips proves the guard RAN clean.
+            metrics.declare("divergence_guard_trips",
+                            "divergence_guard_rollbacks")
         self._queue: Deque[Tuple[int, object]] = deque()
         self.consecutive = 0
         self.total_skipped = 0

@@ -42,6 +42,7 @@ watchdog exits 124 through the same taxonomy.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import socket
 import sys
@@ -54,6 +55,8 @@ import numpy as np
 from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED, EXIT_SIGTERM
 from ..resilience.garble import health_status
 from .engine import Completion, Dropped, ServingEngine
+
+log = logging.getLogger("cst_captioning_tpu.serving.server")
 
 
 class CaptionServer:
@@ -159,8 +162,11 @@ class CaptionServer:
             try:
                 self._write(respond, {"id": None, "error": "bad_request",
                                       "detail": f"line handling failed: {e}"})
-            except Exception:
-                pass
+            except Exception as werr:
+                # The error ANSWER failed too (client hung up mid-line):
+                # already counted above; log so the double fault is
+                # visible (cstlint:bare-except-swallow).
+                log.debug("error response write failed: %r", werr)
 
     def _handle_line_inner(self, line: str,
                            respond: Callable[[str], None]):

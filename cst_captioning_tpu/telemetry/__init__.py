@@ -102,6 +102,12 @@ class Telemetry:
     def inc(self, name: str, n: float = 1) -> None:
         self.registry.inc(name, n)
 
+    def declare(self, *names: str) -> None:
+        """Pre-register counters at 0 (registry.declare passthrough) —
+        every component that increments through this facade declares its
+        names at attach time (enforced by cstlint:declared-counters)."""
+        self.registry.declare(*names)
+
     def flush(self, fsync: bool = False) -> None:
         self.registry.flush(fsync=fsync)
         if self.tracer is not None and fsync:

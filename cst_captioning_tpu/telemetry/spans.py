@@ -26,7 +26,6 @@ Design constraints, in priority order:
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 import time
@@ -190,10 +189,11 @@ class SpanTracer:
                 "wall_epoch_unix_s": self._wall_epoch,
             },
         }
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)  # a killed run leaves whole files, not torn
+        from ..resilience.integrity import atomic_json_write
+
+        # Was a hand-rolled tmp+replace (whole files, not torn) — the
+        # shared discipline adds the data/dir fsyncs for free.
+        atomic_json_write(path, doc)
 
     def flush(self) -> None:
         """Write buffered events out now (a complete part file)."""

@@ -25,6 +25,12 @@ from ..telemetry.spans import NULL_SPAN
 
 log = logging.getLogger(__name__)
 
+#: Integrity-layer audit counters, declared at 0 when telemetry attaches
+#: so snapshots distinguish "armed, nothing happened" from "absent"
+#: (cstlint:declared-counters).
+COUNTERS = ("checkpoints_saved", "checkpoints_quarantined",
+            "checkpoint_walkbacks")
+
 
 class CheckpointManager:
     """Orbax-backed manager writing ``step``-numbered checkpoints.
@@ -68,6 +74,8 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self._faults = fault_plan
         self._telemetry = telemetry
+        if telemetry is not None:
+            telemetry.declare(*COUNTERS)
         self._verify_cache: Dict[tuple, Tuple[str, str]] = {}
         os.makedirs(self.directory, exist_ok=True)
         # BEFORE orbax indexes anything: a step torn by a crash mid-save

@@ -144,9 +144,11 @@ def upload_table_chunked(read_fn, n: int, shapes, dtype, sharding,
         ix = np.arange(start, min(start + chunk_rows, n))
         for m, arr in enumerate(read_fn(ix)):
             if dtype is not None:
+                # cstlint: disable=device-scalar-fetch -- read_fn returns host h5/numpy rows; this is a host-side dtype cast BEFORE device_put, not a device fetch.
                 arr = np.asarray(arr, dtype=dtype)
             chunk = jax.device_put(arr, sharding)
             tables[m] = _write(tables[m], chunk, np.int32(start))
+        # cstlint: disable=device-scalar-fetch -- deliberate per-chunk barrier: bounds upload to ONE chunk in flight (docstring contract) so a wedged tunnel is watchdog-visible; startup path, not the step loop.
         jax.block_until_ready(tables)
         if beat is not None:
             beat()  # each completed chunk is watchdog-visible progress
@@ -240,7 +242,8 @@ class Trainer:
         # Preemption counters are declared at 0 up front so every
         # heartbeat/exit snapshot carries them: a reader can tell "armed,
         # nothing happened" from "feature absent" (registry.declare).
-        self._telemetry.registry.declare("preempt_signals", "preempt_saves")
+        self._telemetry.registry.declare("preempt_signals", "preempt_saves",
+                                         "negative_advantage_aborts")
         # Tuned-config provenance (opts.apply_tuned_defaults) rides into
         # the telemetry.json exit snapshot: every run answers "which axes
         # came from which tuning record" without consulting the CLI line

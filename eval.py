@@ -24,6 +24,7 @@ from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
 from cst_captioning_tpu.data.loader import CaptionLoader
 from cst_captioning_tpu.opts import parse_opts
 from cst_captioning_tpu.parallel.mesh import make_mesh
+from cst_captioning_tpu.resilience.integrity import atomic_json_write
 from cst_captioning_tpu.training.checkpoint import CheckpointManager
 from cst_captioning_tpu.training.evaluation import eval_split
 from cst_captioning_tpu.training.state import create_train_state, make_optimizer
@@ -166,8 +167,8 @@ def main(argv=None) -> int:
             )
     log.info("test scores: %s", {k: round(v, 4) for k, v in scores.items()})
     if opt.result_file:
-        with open(opt.result_file, "w") as f:
-            json.dump({"scores": scores, "predictions": preds}, f, indent=2)
+        atomic_json_write(opt.result_file,
+                          {"scores": scores, "predictions": preds}, indent=2)
         log.info("wrote %s", opt.result_file)
     print(json.dumps(scores))
     return 0

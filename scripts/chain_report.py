@@ -27,7 +27,15 @@ import argparse
 import json
 import os
 import re
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
+    atomic_json_write,
+)
 
 STAGES = ("xe", "wxe", "cst", "cst_scb", "cst_scb_sample")
 
@@ -328,8 +336,8 @@ def main() -> int:
     report["beam"] = {stage: s for stage, s in beam}
 
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump(report, f, indent=2)
+        # collect_evidence bundles this file: it must never be torn.
+        atomic_json_write(args.json, report, indent=2)
         print(f"\n(report JSON -> {args.json})")
     return 0
 

@@ -40,6 +40,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
+    atomic_json_write,
+)
 from cst_captioning_tpu.utils.platform import run_in_group  # noqa: E402
 from scale_chain import probe_device  # noqa: E402
 
@@ -169,8 +172,8 @@ def main() -> int:
                       "--top", "25"],
                      args.out_dir, args.step_timeout, log)
 
-    with open(os.path.join(args.out_dir, "window_log.json"), "w") as f:
-        json.dump(log, f, indent=2)
+    atomic_json_write(os.path.join(args.out_dir, "window_log.json"),
+                      log, indent=2)
     ok = sum(1 for e in log if e["rc"] == 0)
     print(f"window done: {ok}/{len(log)} steps succeeded "
           f"-> {args.out_dir}", flush=True)

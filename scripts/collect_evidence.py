@@ -37,6 +37,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from chain_report import STAGES  # noqa: E402  (one stage list)
+from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
+    atomic_json_write,
+)
 from cst_captioning_tpu.utils.platform import git_head_sha  # noqa: E402
 
 
@@ -108,6 +111,36 @@ def main() -> int:
     copied += [r for r in ("report.md", "report.json")
                if os.path.exists(os.path.join(dst, r))]
 
+    # Static-analysis receipt (ANALYSIS.md): the bundle carries the lint
+    # JSON so a chaos drill's evidence proves the tree it ran on was
+    # clean of invariant violations — same degrade-don't-block contract
+    # as chain_report above (a wedged lint leaves lint_rc nonzero, never
+    # a missing MANIFEST).
+    lint_json = os.path.join(dst, "lint.json")
+    lint_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    lint_rc = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "scripts/cstlint.py", "--json"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=300, env=lint_env,
+        )
+        lint_rc = proc.returncode
+        # Parse-then-atomic-write: a lint child killed mid-print can
+        # never leave a torn lint.json in the bundle (exit 1 with
+        # violations still prints complete JSON and is bundled).
+        atomic_json_write(lint_json, json.loads(proc.stdout), indent=2)
+        copied.append("lint.json")
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        # lint_rc stays the CHILD's verdict when the lint itself ran —
+        # a bundle-write failure must never read as "violations found"
+        # (the receipt's absence from `files` records the write failure;
+        # lint_rc=1 is reserved for an actually-dirty tree).
+        if lint_rc is None:
+            lint_rc = 124 if isinstance(e, subprocess.TimeoutExpired) else 1
+        print(f"lint receipt not bundled ({type(e).__name__}); writing "
+              f"MANIFEST with lint_rc={lint_rc}", file=sys.stderr)
+
     regen = args.regen
     if not regen:
         try:
@@ -126,10 +159,11 @@ def main() -> int:
         "git_sha": git_head_sha(REPO),
         "regen_command": regen,
         "report_rc": rc,
+        "lint_rc": lint_rc,
         "files": sorted(copied),
     }
-    with open(os.path.join(dst, "MANIFEST.json"), "w") as f:
-        json.dump(manifest, f, indent=2)
+    atomic_json_write(os.path.join(dst, "MANIFEST.json"), manifest,
+                      indent=2)
     print(f"collected {len(copied)} files -> {dst}")
     return 0
 

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""cstlint CLI — `make lint` / `make lint-json` (ANALYSIS.md).
+
+Runs the project-native static-analysis pass (analysis/) over the
+enforcement surface (cst_captioning_tpu/, scripts/, the top-level CLIs)
+and reports every unsuppressed violation of the repo's hard-won
+invariants: device-scalar fetches in hot loops, durable JSON writes
+bypassing atomic_json_write, undeclared counters, untyped exits,
+silent exception swallows, and donated-but-unaliased jit buffers.
+
+Usage:
+  python scripts/cstlint.py                 # human output, full tree
+  python scripts/cstlint.py --json          # machine output (evidence)
+  python scripts/cstlint.py --rules exit-taxonomy,atomic-write
+  python scripts/cstlint.py --no-trace      # AST rules only (no jax)
+  python scripts/cstlint.py --list-rules
+  python scripts/cstlint.py scripts/serve.py train.py   # subset of files
+
+Exit codes (resilience/exitcodes.py): 0 clean, 1 violations, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cst_captioning_tpu.resilience.exitcodes import (  # noqa: E402
+    EXIT_FAILURE,
+    EXIT_OK,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="project-native static analysis (ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files to lint (default: the "
+                         "whole enforcement surface)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip jax-tracing rules (donation-audit); "
+                         "pure-AST pass, no jax import")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args()
+
+    if not args.no_trace:
+        # The donation audit lowers real programs; never let that touch
+        # a remote-TPU tunnel (conftest rationale: utils/platform.py).
+        from cst_captioning_tpu.utils.platform import force_cpu_platform
+        force_cpu_platform()
+
+    from cst_captioning_tpu.analysis import (
+        RULES,
+        lint_tree,
+        render_human,
+        render_json,
+    )
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:22s} {RULES[name].doc}")
+        return EXIT_OK
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        result = lint_tree(REPO, rules=rules, trace=not args.no_trace,
+                           paths=args.paths or None)
+    except KeyError as e:
+        ap.error(str(e.args[0]) if e.args else str(e))
+    except OSError as e:
+        ap.error(f"cannot read lint target: {e}")
+
+    print(render_json(result) if args.json else render_human(result))
+    return EXIT_OK if result.clean else EXIT_FAILURE
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,19 +16,20 @@ Usage:
 import argparse
 import glob
 import os
-import sys
 from collections import defaultdict
 
 
-def find_xplane(path: str) -> str:
+def find_xplane(path: str) -> str | None:
+    """Newest ``*.xplane.pb`` under ``path`` (or ``path`` itself when it
+    is a file); None when the directory holds no capture — the caller
+    turns that into a one-line argparse usage error (exit 2, the
+    taxonomy's EXIT_USAGE: a missing capture is operator input, not a
+    failure of this tool)."""
     if os.path.isfile(path):
         return path
     hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
                             recursive=True))
-    if not hits:
-        sys.exit(f"no *.xplane.pb under {path!r} — was the trace captured "
-                 "with --profile_dir (or jax.profiler.trace)?")
-    return hits[-1]  # newest capture
+    return hits[-1] if hits else None  # newest capture
 
 
 def main():
@@ -43,9 +44,16 @@ def main():
                         "(e.g. 'TPU'); default: device planes, then host")
     args = p.parse_args()
 
+    # Resolve the capture BEFORE importing jax: a bad path fails in
+    # milliseconds with a usage line instead of after backend bring-up.
+    xplane = find_xplane(args.trace)
+    if xplane is None:
+        p.error(f"no *.xplane.pb under {args.trace!r} — was the trace "
+                "captured with --profile_dir (or jax.profiler.trace)?")
+
     from jax.profiler import ProfileData
 
-    pd = ProfileData.from_file(find_xplane(args.trace))
+    pd = ProfileData.from_file(xplane)
     planes = list(pd.planes)
     if args.plane:
         planes = [pl for pl in planes if args.plane in pl.name]

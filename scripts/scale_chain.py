@@ -49,6 +49,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from cst_captioning_tpu.resilience import exitcodes  # noqa: E402
+from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
+    atomic_json_write,
+)
 from cst_captioning_tpu.utils.platform import run_in_group  # noqa: E402
 from cst_captioning_tpu.utils.watchdog import WEDGE_EXIT_CODE  # noqa: E402
 
@@ -368,10 +371,13 @@ def generate_data(root: str, num_videos: int, num_val: int,
         # embeddings with garbage metrics).  Refuse; the operator picks a
         # fresh --out_dir or deletes the stale checkpoints deliberately.
         if guard_dir and os.path.isdir(guard_dir) and os.listdir(guard_dir):
-            raise SystemExit(
-                f"dataset spec changed but {guard_dir} holds checkpoints "
-                "trained on the previous dataset; use a fresh --out_dir "
-                "(or delete the old checkpoints) instead of mixing them")
+            print(f"dataset spec changed but {guard_dir} holds checkpoints "
+                  "trained on the previous dataset; use a fresh --out_dir "
+                  "(or delete the old checkpoints) instead of mixing them",
+                  file=sys.stderr)
+            # Operator-config refusal -> the taxonomy's usage class, so
+            # a supervisor never retries what only a human can resolve.
+            raise SystemExit(exitcodes.EXIT_USAGE)
     os.makedirs(root, exist_ok=True)
     t0 = time.time()
     spec = SyntheticSpec(
@@ -388,10 +394,10 @@ def generate_data(root: str, num_videos: int, num_val: int,
     )
     val = generate(root, "val", val_spec, vocab=vocab)
     paths = {"train": train, "val": val}
-    with open(marker + ".paths", "w") as f:
-        json.dump(paths, f)
-    with open(marker, "w") as f:
-        json.dump(spec_dict, f)
+    # The marker seals "dataset generation completed": it must never be
+    # readable half-written, or a resumed chain would trust a torn spec.
+    atomic_json_write(marker + ".paths", paths)
+    atomic_json_write(marker, spec_dict)
     print(f"dataset generated in {time.time() - t0:.0f}s -> {root}")
     return paths
 
@@ -489,7 +495,8 @@ def main() -> int:
     # run_in_group's finally can reap the stage child — the default
     # disposition would kill this harness and orphan the stage against
     # the device.
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    signal.signal(signal.SIGTERM,
+                  lambda *_: sys.exit(exitcodes.EXIT_SIGTERM))
 
     root = os.path.join(args.out_dir, "data")
     ckpt = os.path.join(args.out_dir, "checkpoints")

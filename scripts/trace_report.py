@@ -20,6 +20,13 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
+    atomic_json_write,
+)
+
 
 def load_events(trace_dir: str):
     """Every complete span event from every trace_*.json part file."""
@@ -107,9 +114,9 @@ def main() -> int:
     rows, wall_ms = summarize(events)
     print_table(rows, wall_ms, len(files))
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump({"wall_ms": wall_ms, "files": files, "spans": rows},
-                      f, indent=2)
+        atomic_json_write(args.json,
+                          {"wall_ms": wall_ms, "files": files,
+                           "spans": rows}, indent=2)
         print(f"\nwrote {args.json}")
     return 0
 

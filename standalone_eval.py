@@ -15,6 +15,7 @@ import json
 import sys
 
 from cst_captioning_tpu.metrics.coco_eval import language_eval
+from cst_captioning_tpu.resilience.integrity import atomic_json_write
 
 
 def main(argv=None) -> int:
@@ -31,8 +32,7 @@ def main(argv=None) -> int:
     scores = language_eval(preds, args.references)
     print(json.dumps(scores, indent=2))
     if args.output:
-        with open(args.output, "w") as f:
-            json.dump(scores, f, indent=2)
+        atomic_json_write(args.output, scores, indent=2)
     return 0
 
 

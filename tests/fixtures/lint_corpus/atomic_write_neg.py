@@ -1,0 +1,18 @@
+"""Near-miss negative: json.dumps to a string, a non-JSON text write,
+and a read-mode open — none of these is a raw durable-JSON write."""
+
+import json
+
+
+def render(doc):
+    return json.dumps(doc, indent=2)
+
+
+def save_notes(path, text):
+    with open(path + "/notes.txt", "w") as f:
+        f.write(text)
+
+
+def load_summary(path):
+    with open(path + "/summary.json") as f:
+        return json.load(f)

@@ -1,0 +1,14 @@
+"""Positive: durable JSON written raw — torn-file exposure on crash.
+Both the positional and keyword mode spellings must be caught."""
+
+import json
+
+
+def save_run_summary(path, doc):
+    with open(path + "/summary.json", "w") as f:
+        json.dump(doc, f, indent=2)
+
+
+def save_run_summary_kw(path, text):
+    with open(path + "/summary.json", mode="w") as f:
+        f.write(text)  # pre-rendered json.dumps: still a raw json write

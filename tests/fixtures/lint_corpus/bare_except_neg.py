@@ -1,0 +1,27 @@
+"""Near-miss negative: broad excepts that account for the failure (log
+or counter), and a NARROW except whose silent pass is allowed."""
+
+import logging
+
+log = logging.getLogger("corpus")
+
+
+def respond_logged(write, payload):
+    try:
+        write(payload)
+    except Exception as e:
+        log.debug("write failed: %r", e)
+
+
+def respond_counted(write, payload, registry):
+    try:
+        write(payload)
+    except Exception:
+        registry.inc("corpus_declared_retries")
+
+
+def best_effort_unlink(os_mod, path):
+    try:
+        os_mod.unlink(path)
+    except OSError:  # narrow type: deliberate best-effort cleanup
+        pass

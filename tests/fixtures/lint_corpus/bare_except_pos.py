@@ -1,0 +1,8 @@
+"""Positive: failure-domain code swallowing Exception with only pass."""
+
+
+def respond(write, payload):
+    try:
+        write(payload)
+    except Exception:
+        pass

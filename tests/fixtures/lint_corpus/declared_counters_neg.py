@@ -1,0 +1,19 @@
+"""Near-miss negative: the same increments, but declared — one via
+registry.declare, one via an engine.COUNTERS-style table that is splat
+into declare at attach time."""
+
+COUNTERS = ("corpus_declared_via_table",)
+
+
+def attach(registry):
+    registry.declare("corpus_declared_retries")
+    registry.declare(*COUNTERS)
+
+
+def on_retry(registry):
+    registry.inc("corpus_declared_retries")
+    registry.inc("corpus_declared_via_table")
+
+
+def on_dynamic(registry, kind):
+    registry.inc(f"corpus_dynamic_{kind}")  # non-literal: out of scope

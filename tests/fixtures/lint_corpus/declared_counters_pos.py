@@ -1,0 +1,6 @@
+"""Positive: a literal counter increment with no declare site anywhere
+in the project — snapshots can't tell 'armed, 0' from 'absent'."""
+
+
+def on_retry(registry):
+    registry.inc("corpus_orphan_retries")

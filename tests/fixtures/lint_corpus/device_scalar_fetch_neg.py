@@ -1,0 +1,22 @@
+"""Near-miss negative: the same conversions, but host-safe (len/shape/
+time arithmetic) or outside any loop — the PR-3/PR-8 discipline."""
+
+import time
+
+
+def train_loop(steps, state, step_fn):
+    device_losses = []
+    for i in range(steps):
+        state, metrics = step_fn(state)
+        device_losses.append(metrics["loss"])   # stays on device
+        n = int(len(device_losses) + 1)          # host arithmetic: fine
+        wall = float(time.perf_counter())        # time call: fine
+        dims = int(metrics["loss"].shape[0])     # shape lookup: fine
+        del n, wall, dims
+    # ONE batched fetch after the loop is the blessed pattern.
+    total = float(sum_host(device_losses))
+    return state, total
+
+
+def sum_host(xs):
+    return len(xs)

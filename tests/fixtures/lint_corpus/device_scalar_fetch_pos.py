@@ -1,0 +1,10 @@
+"""Positive: per-step device scalar fetch inside a hot-path loop — the
+exact pattern PR 3 removed from the trainer's control plane."""
+
+
+def train_loop(steps, state, step_fn):
+    losses = []
+    for _ in range(steps):
+        state, metrics = step_fn(state)
+        losses.append(float(metrics["loss"]))  # fetches a device scalar
+    return state, losses

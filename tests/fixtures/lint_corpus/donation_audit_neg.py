@@ -1,0 +1,16 @@
+"""Near-miss negative: the same program shape, but the step returns the
+updated state — the donated input aliases the matching output and the
+buffer is genuinely reused in place."""
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    def step(state, x):
+        return state + x, jnp.sum(x)
+
+    lowered = jax.jit(step, donate_argnums=(0,)).lower(
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    return lowered, 1

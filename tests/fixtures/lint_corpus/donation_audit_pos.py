@@ -1,0 +1,18 @@
+"""Positive: a jit program donating a buffer NO output can alias — the
+donated (4,) f32 input has no same-shape/dtype output, so XLA silently
+skips the donation (the audit must catch the unfreed buffer)."""
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+
+    def step(state, x):
+        # state is donated but the outputs are (3,) i32 and scalar f32:
+        # nothing matches the donated (4,) f32 aval.
+        return jnp.zeros((3,), jnp.int32), jnp.sum(x) + jnp.sum(state)
+
+    lowered = jax.jit(step, donate_argnums=(0,)).lower(
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    return lowered, 1

@@ -1,0 +1,22 @@
+"""Near-miss negative: exits through named taxonomy constants, a main()
+return value, and a bare re-raise-style exit — all classifiable."""
+
+import sys
+
+from cst_captioning_tpu.resilience.exitcodes import EXIT_USAGE
+
+
+def main() -> int:
+    return 0
+
+
+def die_typed():
+    sys.exit(EXIT_USAGE)
+
+
+def run():
+    sys.exit(main())
+
+
+def stop():
+    sys.exit()

@@ -1,0 +1,15 @@
+"""Positive: bare-int and string exits that bypass the taxonomy."""
+
+import sys
+
+
+def die_numeric():
+    sys.exit(3)
+
+
+def die_negative():
+    sys.exit(-1)  # UnaryOp spelling: exits 255 untyped
+
+
+def die_stringly(path):
+    sys.exit(f"no trace under {path!r}")

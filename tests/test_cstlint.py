@@ -1,0 +1,290 @@
+"""cstlint acceptance (ISSUE 10): every rule proven by its seeded
+corpus (positive fires, near-miss doesn't), the suppression grammar
+(required justification, statement-span coverage, stale detection), the
+donation audit against every registered jit entry point, the CLI
+contract, and — the CI-equivalent enforcement — the clean-tree gate:
+the committed tree reports ZERO unsuppressed violations.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "fixtures", "lint_corpus")
+
+from cst_captioning_tpu.analysis import (  # noqa: E402
+    RULES,
+    lint_sources,
+    lint_tree,
+    render_json,
+)
+from cst_captioning_tpu.analysis.donation import (  # noqa: E402
+    audit_entry_points,
+    audit_lowered,
+    ENTRY_POINTS,
+)
+from cst_captioning_tpu.resilience.exitcodes import (  # noqa: E402
+    EXIT_FAILURE,
+    EXIT_OK,
+    EXIT_USAGE,
+)
+
+#: rule -> (corpus basename, virtual repo path the rule scopes to).
+AST_CORPUS = {
+    "device-scalar-fetch": ("device_scalar_fetch",
+                            "cst_captioning_tpu/training/trainer.py"),
+    "atomic-write": ("atomic_write", "scripts/somescript.py"),
+    "declared-counters": ("declared_counters",
+                          "cst_captioning_tpu/data/somemodule.py"),
+    "exit-taxonomy": ("exit_taxonomy", "scripts/somescript.py"),
+    "bare-except-swallow": ("bare_except",
+                            "cst_captioning_tpu/serving/somemodule.py"),
+}
+
+
+def corpus_text(basename: str, kind: str) -> str:
+    with open(os.path.join(CORPUS, f"{basename}_{kind}.py")) as f:
+        return f.read()
+
+
+def run_rule(rule: str, text: str, relpath: str):
+    res = lint_sources([(relpath, text)], rules=[rule])
+    return [v for v in res.violations if v.rule == rule]
+
+
+# -- per-rule corpus: positive fires, near-miss doesn't --------------------
+
+
+@pytest.mark.parametrize("rule", sorted(AST_CORPUS))
+def test_corpus_positive_fires(rule):
+    base, vpath = AST_CORPUS[rule]
+    hits = run_rule(rule, corpus_text(base, "pos"), vpath)
+    assert hits, f"{rule} must fire on its seeded positive"
+
+
+@pytest.mark.parametrize("rule", sorted(AST_CORPUS))
+def test_corpus_near_miss_negative_silent(rule):
+    base, vpath = AST_CORPUS[rule]
+    hits = run_rule(rule, corpus_text(base, "neg"), vpath)
+    assert hits == [], f"{rule} fired on its near-miss negative: {hits}"
+
+
+def test_device_scalar_fetch_scoped_to_hot_paths():
+    """The SAME positive source outside the hot-path set is silent —
+    the rule encodes where the garble caveat bites, not a style ban."""
+    text = corpus_text("device_scalar_fetch", "pos")
+    assert run_rule("device-scalar-fetch", text,
+                    "cst_captioning_tpu/metrics/ngrams.py") == []
+
+
+def test_atomic_write_home_module_exempt():
+    """integrity.py itself must spell the raw write."""
+    text = corpus_text("atomic_write", "pos")
+    assert run_rule("atomic-write", text,
+                    "cst_captioning_tpu/resilience/integrity.py") == []
+
+
+def test_bare_except_scoped_to_failure_domains():
+    text = corpus_text("bare_except", "pos")
+    assert run_rule("bare-except-swallow", text,
+                    "cst_captioning_tpu/metrics/ngrams.py") == []
+
+
+# -- donation audit (jaxpr-level) ------------------------------------------
+
+
+def _load_corpus_module(name):
+    spec = importlib.util.spec_from_file_location(
+        f"lint_corpus_{name}", os.path.join(CORPUS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_donation_corpus_positive_fires():
+    lowered, donated = _load_corpus_module("donation_audit_pos").build()
+    problems = audit_lowered(lowered, donated)
+    assert problems and "aliased" in problems[0]
+
+
+def test_donation_corpus_negative_clean():
+    lowered, donated = _load_corpus_module("donation_audit_neg").build()
+    assert audit_lowered(lowered, donated) == []
+
+
+def test_registered_entry_points_all_alias():
+    """Acceptance: the donation-audit rule passes against EVERY
+    registered jit entry point (trainer XE, fused CST, serving
+    greedy/beam chunk + admit) — the mechanized form of the PR-3/PR-6
+    hand audits."""
+    results = audit_entry_points()
+    assert set(results) == set(ENTRY_POINTS)
+    assert len(results) >= 6
+    bad = {k: v for k, v in results.items() if v}
+    assert not bad, f"donation regressions: {bad}"
+
+
+# -- suppression grammar ---------------------------------------------------
+
+
+POS_EXIT = 'import sys\nsys.exit(3)\n'
+
+
+def test_suppression_with_justification_applies():
+    src = ('import sys\n'
+           '# cstlint: disable=exit-taxonomy -- corpus: typed exit '
+           'tested elsewhere\n'
+           'sys.exit(3)\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["exit-taxonomy"])
+    assert res.clean
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0][1].justification.startswith("corpus:")
+
+
+def test_trailing_suppression_applies_to_own_line():
+    src = ('import sys\n'
+           'sys.exit(3)  # cstlint: disable=exit-taxonomy -- corpus ok\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["exit-taxonomy"])
+    assert res.clean and len(res.suppressed) == 1
+
+
+def test_suppression_without_justification_is_violation_and_inert():
+    src = ('import sys\n'
+           '# cstlint: disable=exit-taxonomy\n'
+           'sys.exit(3)\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["exit-taxonomy"])
+    rules_hit = sorted(v.rule for v in res.violations)
+    assert rules_hit == ["exit-taxonomy", "suppression-format"]
+
+
+def test_suppression_covers_multiline_statement():
+    src = ('import sys\n'
+           '# cstlint: disable=exit-taxonomy -- corpus: spans the call\n'
+           'sys.exit(\n'
+           '    3)\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["exit-taxonomy"])
+    assert res.clean and len(res.suppressed) == 1
+
+
+def test_stale_suppression_reported():
+    """Satellite: a disable whose rule no longer fires is itself a
+    violation — justified exceptions can't rot silently."""
+    src = ('import sys\n'
+           '# cstlint: disable=exit-taxonomy -- was a literal, now fixed\n'
+           'sys.exit()\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["exit-taxonomy"])
+    assert [v.rule for v in res.violations] == ["stale-suppression"]
+    assert "was a literal" in res.violations[0].message
+
+
+def test_stale_not_reported_for_rules_that_did_not_run():
+    """A --rules subset must not mass-expire other rules' receipts."""
+    src = ('import sys\n'
+           '# cstlint: disable=exit-taxonomy -- exercised under full runs\n'
+           'sys.exit(3)\n')
+    res = lint_sources([("scripts/x.py", src)], rules=["atomic-write"])
+    assert res.clean
+
+
+def test_wrong_rule_suppression_does_not_apply():
+    src = ('import sys\n'
+           '# cstlint: disable=atomic-write -- wrong rule on purpose\n'
+           'sys.exit(3)\n')
+    res = lint_sources([("scripts/x.py", src)],
+                       rules=["exit-taxonomy", "atomic-write"])
+    assert sorted(v.rule for v in res.violations) == [
+        "exit-taxonomy", "stale-suppression"]
+
+
+# -- the clean-tree gate (CI-equivalent enforcement) -----------------------
+
+
+def test_tree_is_clean_ast_rules():
+    """The committed tree has zero unsuppressed AST-rule violations and
+    every suppression carries a justification.  (The donation rule is
+    covered by test_registered_entry_points_all_alias; skipping trace
+    here keeps this test jax-build-free.)"""
+    res = lint_tree(REPO, trace=False)
+    assert res.files_scanned > 80
+    assert res.violations == [], "\n".join(
+        v.render() for v in res.violations)
+    for v, s in res.suppressed:
+        assert s.justification, f"unjustified suppression at {v.path}"
+
+
+def test_render_json_schema():
+    res = lint_tree(REPO, trace=False,
+                    paths=["cst_captioning_tpu/resilience/exitcodes.py"])
+    import json as _json
+
+    doc = _json.loads(render_json(res))
+    assert doc["schema"] == 1
+    assert doc["clean"] is True
+    assert doc["files_scanned"] == 1
+    assert "donation-audit" not in doc["rules_ran"]  # trace off
+
+
+def test_every_shipped_rule_registered():
+    expected = {"device-scalar-fetch", "atomic-write", "declared-counters",
+                "exit-taxonomy", "bare-except-swallow", "donation-audit"}
+    assert expected <= set(RULES)
+
+
+# -- CLI contract ----------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(
+        [sys.executable, "scripts/cstlint.py", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120, env=env)
+
+
+def test_cli_list_rules():
+    p = _run_cli("--list-rules")
+    assert p.returncode == EXIT_OK
+    for name in ("device-scalar-fetch", "donation-audit"):
+        assert name in p.stdout
+
+
+def test_cli_clean_subset_exits_ok():
+    p = _run_cli("--no-trace", "scripts/cstlint.py")
+    assert p.returncode == EXIT_OK, p.stdout + p.stderr
+    assert "clean" in p.stdout
+
+
+def test_cli_unknown_rule_is_usage_error():
+    p = _run_cli("--rules", "no-such-rule")
+    assert p.returncode == EXIT_USAGE
+    assert "unknown rule" in p.stderr
+
+
+def test_cli_violations_exit_failure(tmp_path):
+    # A seeded-bad file via explicit path: corpus positive, linted as a
+    # scripts/ file.  Write it inside the repo? No — paths are
+    # repo-relative, so use a relative path pointing at the corpus copy
+    # presented under its real (tests/...) path, where exit-taxonomy
+    # still applies (the rule is tree-wide).
+    p = _run_cli("--no-trace", "--rules", "exit-taxonomy",
+                 "tests/fixtures/lint_corpus/exit_taxonomy_pos.py")
+    assert p.returncode == EXIT_FAILURE
+    assert "exit-taxonomy" in p.stdout
+
+
+# -- satellite: profile_top's usage error (first exit-taxonomy catch) ------
+
+
+def test_profile_top_missing_trace_is_usage_error(tmp_path):
+    """scripts/profile_top.py with a capture-less dir exits 2 (usage)
+    with a one-line diagnostic — no longer sys.exit(<string>) == 1."""
+    p = subprocess.run(
+        [sys.executable, "scripts/profile_top.py", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert p.returncode == EXIT_USAGE
+    assert "no *.xplane.pb" in p.stderr
+    # argparse prints usage + the one-line error; nothing on stdout.
+    assert p.stdout == ""

@@ -172,10 +172,25 @@ def test_two_process_backend(tmp_path):
         )
         for i in range(2)
     ]
+    # Container signature (PR 9 notes): this image's jaxlib CPU client
+    # has no cross-process collective support at all — the very first
+    # sharded device_put dies fast with this exact XLA error.  That is
+    # an environment capability gap, not a regression in the code under
+    # test, so it skips with the documented reason; ANY other child
+    # failure (hang, assert, different error) still fails the test, and
+    # on a container whose jaxlib does support multiprocess CPU this
+    # test runs for real again.
+    NO_MULTIPROCESS_CPU = (
+        "Multiprocess computations aren't implemented on the CPU backend")
     results = []
     try:
         for p in procs:
             out, err = p.communicate(timeout=420)
+            if p.returncode != 0 and NO_MULTIPROCESS_CPU in err:
+                pytest.skip(
+                    "container jaxlib lacks multiprocess CPU collectives "
+                    f"({NO_MULTIPROCESS_CPU!r}); the 2-process DCN "
+                    "drill needs a backend with cross-process support")
             assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
             results.append(json.loads(out.strip().splitlines()[-1]))
     finally:
