@@ -44,10 +44,13 @@ test:
 # RESILIENCE.md/SERVING.md invariants — no device-scalar fetches in hot
 # loops, durable JSON through atomic_json_write, counters declared at 0,
 # exits through the taxonomy, no silent exception swallows, every
-# donated jit buffer actually aliased.  Exit 0 = clean tree (every
-# suppression carries a written justification); the same run rides in
-# tier-1 via tests/test_cstlint.py.  `lint-json` emits the machine
-# report that collect_evidence bundles into MANIFESTs.
+# donated jit buffer actually aliased — plus the CONCURRENCY contracts
+# (reported as their own [concurrency] group): guarded_by/owned_by
+# annotations, LOCK_ORDER embedding, signal-handler safety, named
+# daemon-stated threads, monotonic deadlines.  Exit 0 = clean tree
+# (every suppression carries a written justification); the same run
+# rides in tier-1 via tests/test_cstlint.py.  `lint-json` emits the
+# machine report that collect_evidence bundles into MANIFESTs.
 lint:
 	JAX_PLATFORMS=cpu $(PY) scripts/cstlint.py
 
@@ -190,8 +193,13 @@ serve-bench:
 # reflecting every injected fault — plus the deadline/TTL eviction units
 # and the double-SIGTERM drain drill.  Includes the `slow` subprocess
 # drills tier-1 skips; the fast slice rides in tier-1 automatically.
+# CST_LOCK_SANITIZER=1 arms the runtime lock sanitizer (analysis/
+# locksan.py) in-process AND in the subprocess drills: the declared
+# LOCK_ORDER is re-validated under every injected fault, and any
+# inversion/undeclared nesting fails the drill with a durable receipt.
 serve-chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving_resilience.py -q
+	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
+	  $(PY) -m pytest tests/test_serving_resilience.py tests/test_locksan.py -q
 
 # -- zero-setup synthetic demo --------------------------------------------
 

@@ -12,6 +12,12 @@ justification, JSON + human output, and a jaxpr-level donation audit —
 run over the whole tree as a tier-1 test (tests/test_cstlint.py) so the
 caveats are law, not tribal knowledge.
 
+ISSUE 11 extends the same engine to the THREADING model: a declared
+concurrency grammar (``guarded_by``/``owned_by`` annotations, per-module
+``LOCK_ORDER`` tables) enforced by six rules in ``concurrency.py``, plus
+``locksan.py`` — the opt-in runtime lock sanitizer that re-validates the
+declared order under the serving chaos drills (``CST_LOCK_SANITIZER=1``).
+
 Entry points: ``scripts/cstlint.py`` / ``make lint`` / ``make lint-json``;
 the rule catalogue and suppression grammar are documented in ANALYSIS.md.
 """
@@ -33,3 +39,4 @@ from .engine import (  # noqa: F401
 # Importing the rule modules registers every shipped rule.
 from . import rules  # noqa: F401,E402
 from . import donation  # noqa: F401,E402
+from . import concurrency  # noqa: F401,E402

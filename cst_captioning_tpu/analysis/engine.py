@@ -80,25 +80,30 @@ class Rule:
 
     def __init__(self, name: str, doc: str,
                  check: Callable[["Project"], Iterable[Violation]],
-                 needs_trace: bool = False):
+                 needs_trace: bool = False, category: str = "core"):
         self.name = name
         self.doc = doc
         self._check = check
         #: True for rules that trace/lower jax programs (donation-audit);
         #: skipped when the run disables tracing.
         self.needs_trace = needs_trace
+        #: Reporting group ("core" | "concurrency"); `--list-rules` and
+        #: the human summary line group by it.
+        self.category = category
 
     def check(self, project: "Project") -> Iterable[Violation]:
         return self._check(project)
 
 
-def rule(name: str, doc: str, needs_trace: bool = False):
+def rule(name: str, doc: str, needs_trace: bool = False,
+         category: str = "core"):
     """Decorator registering a check function under ``name``."""
     if name in META_RULES:
         raise ValueError(f"{name!r} is reserved for the engine")
 
     def deco(fn):
-        RULES[name] = Rule(name, doc, fn, needs_trace=needs_trace)
+        RULES[name] = Rule(name, doc, fn, needs_trace=needs_trace,
+                           category=category)
         return fn
 
     return deco
@@ -342,7 +347,15 @@ def render_human(result: LintResult) -> str:
     lines = [v.render() for v in result.violations]
     counts = result.summary()
     if counts:
-        per_rule = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        # Group the per-rule summary by rule category (core vs the
+        # concurrency contracts) so `make lint` reads as two audits.
+        groups: Dict[str, List[str]] = {}
+        for name, n in sorted(counts.items()):
+            cat = RULES[name].category if name in RULES else "meta"
+            groups.setdefault(cat, []).append(f"{name}={n}")
+        per_rule = " | ".join(
+            f"{cat}: " + ", ".join(parts)
+            for cat, parts in sorted(groups.items()))
         lines.append(f"cstlint: {len(result.violations)} violation(s) "
                      f"[{per_rule}] in {result.files_scanned} file(s)")
     else:

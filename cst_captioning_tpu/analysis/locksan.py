@@ -1,0 +1,24 @@
+"""Runtime lock sanitizer — analysis-side façade.
+
+The implementation lives in ``cst_captioning_tpu/utils/locksan.py`` so
+that runtime modules creating locks (telemetry, serving, native) depend
+only on a stdlib-only leaf module and never pull the lint engine into a
+serving process's import graph.  This module re-exports the full surface
+under the analysis package, where the concurrency rules (ANALYSIS.md
+"Concurrency contracts") document it: the ``lock-order`` rule resolves
+lock expressions through ``named_lock`` assignments and reads the same
+``LOCK_ORDER`` tables that ``declare_order`` registers at runtime.
+"""
+
+from ..utils.locksan import (  # noqa: F401
+    DEFAULT_RECEIPT,
+    ENV_FLAG,
+    ENV_RECEIPT,
+    LOCKSAN_SCHEMA,
+    LockOrderViolation,
+    declare_order,
+    enabled,
+    named_lock,
+    reset_observed,
+    violations,
+)

@@ -346,7 +346,9 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
             _put(e)
         _put(stop)
 
-    t = threading.Thread(target=work, daemon=True)
+    # Named so trace viewers (SpanTracer tid rows) and locksan receipts
+    # can attribute this worker's spans (cstlint:thread-discipline).
+    t = threading.Thread(target=work, name="loader-prefetch", daemon=True)
     t.start()
     try:
         while True:

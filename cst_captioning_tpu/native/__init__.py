@@ -18,16 +18,20 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
 
+from ..utils.locksan import named_lock
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "ciderd.cpp")
 _LIB = os.path.join(_DIR, "libciderd.so")
-_LOCK = threading.Lock()
-_loaded: Optional[ctypes.CDLL] = None
+# One build/load lock for BOTH libraries; library handles are guarded so
+# two threads racing first-use can never double-build or load a
+# half-written .so (cstlint:guarded-by).
+_LOCK = named_lock("native.build")
+_loaded: Optional[ctypes.CDLL] = None  # cstlint: guarded_by=_LOCK
 
 
 class NativeUnavailable(RuntimeError):
@@ -274,7 +278,7 @@ class NativeCiderD:
 
 _TOK_SRC = os.path.join(_DIR, "tokenizer.cpp")
 _TOK_LIB = os.path.join(_DIR, "libptbtok.so")
-_tok_loaded: Optional[ctypes.CDLL] = None
+_tok_loaded: Optional[ctypes.CDLL] = None  # cstlint: guarded_by=_LOCK
 
 
 def load_tokenizer_library() -> ctypes.CDLL:

@@ -70,7 +70,9 @@ class PreemptionHandler:
         # the first handler sits inside set() would re-enter and deadlock
         # the main thread on a lock it already holds, hanging the process
         # until the scheduler's SIGKILL.  GIL-atomic attribute writes need
-        # no lock at all.
+        # no lock at all.  Mechanized: cstlint:signal-safe-handler walks
+        # every function reachable from a signal.signal registration and
+        # rejects Event/Lock ops, logging, and print.
         self._requested = False
         self.signal_name: Optional[str] = None
         self.signal_monotonic: Optional[float] = None

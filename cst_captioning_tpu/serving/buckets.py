@@ -19,12 +19,20 @@ production is a visible counter, not a silent latency cliff.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, Optional, Tuple
+
+from ..utils.locksan import declare_order, named_lock
 
 #: The shipped bucket ladder: smallest-sufficient bucket per load level,
 #: grow-only under pressure (SERVING.md "Bucket policy").
 DEFAULT_BUCKETS = (1, 4, 8)
+
+#: Declared acquisition order (cstlint:lock-order + the runtime
+#: sanitizer): the cache lock may be held while bumping the registry's
+#: counter lock (`get` counts a won build inside its critical section),
+#: never the reverse — the registry is a leaf lock project-wide.
+LOCK_ORDER = ("serving.programs", "telemetry.registry")
+declare_order(*LOCK_ORDER)
 
 
 def parse_buckets(spec) -> Tuple[int, ...]:
@@ -74,9 +82,11 @@ class ProgramCache:
     """
 
     def __init__(self, registry=None):
-        self._lock = threading.Lock()
-        self._programs: Dict[tuple, Callable] = {}
+        self._lock = named_lock("serving.programs")
+        self._programs: Dict[tuple, Callable] = {}  # cstlint: guarded_by=self._lock
         self._registry = registry
+        # builds is read lock-free by the engine's stats path (a torn
+        # int read is impossible under the GIL); writes stay locked.
         self.builds = 0
 
     def get(self, key: tuple, build: Callable[[], Callable]) -> Callable:

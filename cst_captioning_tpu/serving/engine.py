@@ -58,6 +58,11 @@ Fault tolerance (RESILIENCE.md "Serving faults"):
 Threading: the engine is single-owner — ``submit``/``step``/``drain``
 must be called from one thread (the server's scheduler loop); front-end
 reader threads hand lines to that loop, never to the engine directly.
+The scheduler-owned state carries ``owned_by=scheduler`` annotations and
+the server's reader threads are checked against them
+(cstlint:thread-ownership); deadlines run on ``time.monotonic`` — the
+``clock`` default the monotonic-deadline rule holds the rest of the
+tree to.
 """
 
 from __future__ import annotations
@@ -230,10 +235,13 @@ class ServingEngine:
         self.clock = clock
 
         self._cache = ProgramCache(registry)
-        self._queue: deque = deque()
-        self._residents: List[Optional[_Resident]] = []
+        # Single-owner scheduler state (the module-docstring threading
+        # contract): if this file ever grows a thread whose target
+        # touches these, cstlint:thread-ownership fires.
+        self._queue: deque = deque()  # cstlint: owned_by=scheduler
+        self._residents: List[Optional[_Resident]] = []  # cstlint: owned_by=scheduler
         self._slots_n = 0
-        self._dev: Optional[Dict[str, Any]] = None
+        self._dev: Optional[Dict[str, Any]] = None  # cstlint: owned_by=scheduler
         self._latencies: deque = deque(maxlen=1024)
         self._chunk_wall: deque = deque(maxlen=128)
         self._dropped: List[Dropped] = []

@@ -52,11 +52,20 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..utils.locksan import LockOrderViolation, declare_order, named_lock
 from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED, EXIT_SIGTERM
 from ..resilience.garble import health_status
 from .engine import Completion, Dropped, ServingEngine
 
 log = logging.getLogger("cst_captioning_tpu.serving.server")
+
+#: Declared acquisition order (cstlint:lock-order + the runtime
+#: sanitizer): ``_write`` serializes whole response lines under the
+#: server-wide write lock and the socket ``respond`` closure then takes
+#: its per-connection send lock — so write-before-conn is the law, and
+#: the sanitizer proves no path ever takes them the other way around.
+LOCK_ORDER = ("serving.server.write", "serving.server.conn")
+declare_order(*LOCK_ORDER)
 
 
 class CaptionServer:
@@ -74,7 +83,10 @@ class CaptionServer:
     def __init__(self, engine: ServingEngine, vocab, feats_for,
                  *, handler=None, out=None, idle_sleep: float = 0.002,
                  watchdog=None, registry=None):
-        self.engine = engine
+        # The engine is single-owner state: reader threads parse lines
+        # into the inbox, ONLY the scheduler loop may touch the engine
+        # (cstlint:thread-ownership — the inbox-owns-intake discipline).
+        self.engine = engine  # cstlint: owned_by=scheduler
         self.vocab = vocab
         self.feats_for = feats_for
         self.handler = handler
@@ -86,8 +98,12 @@ class CaptionServer:
             registry.declare("serve_bad_lines", "serve_health_queries")
         self._inbox: "queue.Queue" = queue.Queue()
         self._eof = threading.Event()
-        self._write_lock = threading.Lock()
-        self._draining = False
+        self._write_lock = named_lock("serving.server.write")
+        self._draining = False  # cstlint: owned_by=scheduler
+        #: The socket front end's bound port; None until run_socket
+        #: binds.  In-process callers (the reader-lifecycle drill) poll
+        #: this instead of scraping the stderr announcement.
+        self.bound_port: Optional[int] = None
 
     # -- responses ---------------------------------------------------------
 
@@ -157,11 +173,18 @@ class CaptionServer:
         any input (pinned by tests/test_serving_resilience.py)."""
         try:
             self._handle_line_inner(line, respond)
+        except LockOrderViolation:
+            # A sanitizer violation is a programming error in THIS
+            # process, not a bad client line: die loudly so the chaos
+            # drill fails (the receipt is already durably on disk).
+            raise
         except Exception as e:  # one bad line must never kill the loop
             self._count_bad_line()
             try:
                 self._write(respond, {"id": None, "error": "bad_request",
                                       "detail": f"line handling failed: {e}"})
+            except LockOrderViolation:
+                raise  # same die-loudly contract as the outer handler
             except Exception as werr:
                 # The error ANSWER failed too (client hung up mid-line):
                 # already counted above; log so the double fault is
@@ -339,12 +362,13 @@ class CaptionServer:
         srv.listen()
         srv.settimeout(0.2)
         bound = srv.getsockname()[1]
+        self.bound_port = bound
         print(f"serve: listening on 127.0.0.1:{bound}", file=sys.stderr)
         sys.stderr.flush()
         conns: List[socket.socket] = []
 
         def reader(conn: socket.socket) -> None:
-            lock = threading.Lock()
+            lock = named_lock("serving.server.conn")
 
             def respond(line: str) -> None:
                 with lock:

@@ -12,6 +12,12 @@ Threading: counters/gauges may be touched from worker threads (loader
 prefetch retries) and read from the watchdog thread (heartbeat payload);
 every mutation holds one small lock.  ``log_step`` is main-thread (the
 trainer's logging cadence), but locks anyway — correctness over the ~µs.
+The instrument tables are annotated ``guarded_by=self._lock`` and the
+lock is created through ``utils.locksan.named_lock`` as
+``telemetry.registry`` — a LEAF in every declared LOCK_ORDER table: no
+registry method may acquire another project lock while holding it
+(enforced by cstlint:guarded-by / cstlint:lock-order + the runtime
+sanitizer).
 
 Schema: every ``metrics.jsonl`` record and the ``telemetry.json`` snapshot
 carry ``"schema": 2`` so downstream readers (scripts/chain_report.py,
@@ -23,9 +29,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from ..utils.locksan import named_lock
 
 #: Version stamped into every metrics.jsonl record and telemetry snapshot.
 METRICS_SCHEMA = 2
@@ -35,14 +42,14 @@ class MetricsRegistry:
     """Counters, gauges, histograms + step-record fan-out to sinks."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {}
-        self._gauges: Dict[str, float] = {}
-        self._hists: Dict[str, Dict[str, float]] = {}
-        self._meta: Dict[str, Any] = {}
+        self._lock = named_lock("telemetry.registry")
+        self._counters: Dict[str, float] = {}      # cstlint: guarded_by=self._lock
+        self._gauges: Dict[str, float] = {}        # cstlint: guarded_by=self._lock
+        self._hists: Dict[str, Dict[str, float]] = {}  # cstlint: guarded_by=self._lock
+        self._meta: Dict[str, Any] = {}            # cstlint: guarded_by=self._lock
         self._sinks: List[Any] = []
-        self._last_train: Optional[Dict[str, Any]] = None
-        self._last_val: Optional[Dict[str, Any]] = None
+        self._last_train: Optional[Dict[str, Any]] = None  # cstlint: guarded_by=self._lock
+        self._last_val: Optional[Dict[str, Any]] = None    # cstlint: guarded_by=self._lock
 
     def set_meta(self, name: str, value: Any) -> None:
         """Run-constant provenance (JSON-serializable) stamped into every

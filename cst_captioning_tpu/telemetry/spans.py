@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..utils.locksan import named_lock
+
 
 class _NullSpan:
     """Shared no-op context manager — the disabled path of every hook."""
@@ -101,12 +103,14 @@ class SpanTracer:
             self._run = SpanTracer._seq
             SpanTracer._seq += 1
         self._process_index = int(process_index)
-        self._lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
-        self._named_tids: set = set()
+        # Buffer state is guarded (any thread may record a span); the
+        # *_locked helper convention marks the callers-hold-it paths.
+        self._lock = named_lock("telemetry.spans")
+        self._events: List[Dict[str, Any]] = []  # cstlint: guarded_by=self._lock
+        self._named_tids: set = set()            # cstlint: guarded_by=self._lock
         self._max = max(1000, int(max_buffered_events))
-        self._part = 0
-        self._closed = False
+        self._part = 0                           # cstlint: guarded_by=self._lock
+        self._closed = False                     # cstlint: guarded_by=self._lock
         # ts epoch: perf_counter is monotonic but has an arbitrary zero;
         # anchor it once so every event's ts is "µs since tracer start"
         # and the wall-clock anchor rides in the file's otherData.

@@ -559,7 +559,7 @@ class Trainer:
         if self._captions_done:  # 0 for steps logged mid-drain-burst:
             # their captions were already counted by the first drained step,
             # so a cps there would be a spurious zero in the metrics stream.
-            dt = time.time() - self._log_t0
+            dt = time.monotonic() - self._log_t0
             cps = self._captions_done / max(dt, 1e-9)
             extra["captions_per_sec"] = cps
             cps_txt = f" | {cps:.0f} captions/s"
@@ -574,7 +574,7 @@ class Trainer:
                     max(1, round(self._captions_done / ncaps))))
                 extra.update(mfu_fields(self._flops_per_step, cps, ncaps,
                                         self._device_kind))
-            self._log_t0, self._captions_done = time.time(), 0
+            self._log_t0, self._captions_done = time.monotonic(), 0
         log.info(
             "step %d/%d epoch %.2f %s lr %.2e%s",
             step1, total_steps, step1 / bpe,
@@ -1212,7 +1212,7 @@ class Trainer:
                 "last_step": start_step,
                 "history": self.history,
             }
-        self._log_t0 = time.time()
+        self._log_t0 = time.monotonic()
         self._captions_done = 0
         # --save_interval_secs counts from the start of THIS process's
         # loop, not from Trainer construction: device bring-up must not

@@ -1,0 +1,257 @@
+"""Runtime lock sanitizer: the dynamic cross-check on declared LOCK_ORDER.
+
+The static ``lock-order`` rule (analysis/concurrency.py) can only see
+LEXICALLY nested ``with`` acquisitions; the orders that actually deadlock
+are usually dynamic — lock A held while a callback takes lock B in a
+different module (the serving server's write lock around a per-connection
+respond lock, the ProgramCache lock around the registry's counter lock).
+And a declared order is a claim that rots: nothing stops a refactor from
+quietly inverting it.  This module closes both gaps at runtime:
+
+- :func:`named_lock` is the project's lock factory.  Disabled (the
+  default), it returns a plain ``threading.Lock`` — zero overhead, no
+  behavior change.  With ``CST_LOCK_SANITIZER=1`` in the environment at
+  creation time it returns a :class:`_SanitizedLock` that records, per
+  thread, every "acquired B while holding A" edge.
+- :func:`declare_order` registers the same per-module ``LOCK_ORDER``
+  tables the static rule checks (each table declares ``names[i]`` may be
+  held while acquiring ``names[j]`` for ``i < j``).
+- On every sanitized acquisition the edge is asserted against the
+  declared partial order BEFORE blocking: an edge that INVERTS a
+  declared path or an edge nobody declared writes a violation receipt
+  through ``resilience.integrity.atomic_json_write`` (so a deadlock
+  that follows cannot tear the evidence) and raises
+  :class:`LockOrderViolation`.  Those two checks are complete: an edge
+  is only ever RECORDED when the declared order covers it, so any
+  would-be cross-thread cycle necessarily contains an edge one of the
+  two checks rejects first (the recorded edges ride in the receipt as
+  diagnostics).
+
+Wired into ``make serve-chaos`` and the tier-1 serving fast slice
+(tests/test_serving_resilience.py sets the env var), so the declared
+order is re-validated under the PR 9 fault drills on every run — the
+receipt requirement is pinned by tests/test_locksan.py.
+
+The implementation lives HERE (utils/) rather than in analysis/ so the
+runtime modules that create locks (telemetry, serving, native) depend
+only on this stdlib-only file — never on the lint engine;
+``analysis.locksan`` re-exports everything for the documented
+analysis-side surface and the static rule's prose.
+
+The sanitizer itself must stay reentrancy-clean: its one internal lock
+(``_state_lock``) is a plain ``threading.Lock`` acquired only with NO
+sanitized lock's internal state mid-update, and the receipt write happens
+outside any sanitized lock the caller does not already hold.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+#: Environment flag read at lock-CREATION time (so a test can arm the
+#: sanitizer for the objects it builds without rebuilding module state).
+ENV_FLAG = "CST_LOCK_SANITIZER"
+#: Where the violation receipt lands; overridable for tests.
+ENV_RECEIPT = "CST_LOCK_SANITIZER_RECEIPT"
+DEFAULT_RECEIPT = "/tmp/cst_locksan_violation.json"
+
+#: Receipt format version.
+LOCKSAN_SCHEMA = 1
+
+
+class LockOrderViolation(AssertionError):
+    """A runtime acquisition contradicted the declared LOCK_ORDER (or an
+    order already observed on another thread).  Raised AFTER the receipt
+    is durably written, so the evidence survives the deadlock this is
+    predicting."""
+
+
+# -- global sanitizer state (guarded by _state_lock) ------------------------
+
+_state_lock = threading.Lock()
+_declared_edges: Set[Tuple[str, str]] = set()
+_declared_tables: List[Tuple[str, ...]] = []
+_observed_edges: Dict[Tuple[str, str], Dict] = {}
+_violations: List[Dict] = []
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """Is the sanitizer armed in this environment right now?"""
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+def declare_order(*names: str) -> None:
+    """Register one LOCK_ORDER table: ``names[i]`` may be held while
+    acquiring ``names[j]`` for every ``i < j``.  Idempotent; modules call
+    it at import time next to their ``LOCK_ORDER`` tuple, so the runtime
+    registry and the statically checked table are the same declaration."""
+    table = tuple(str(n) for n in names)
+    if len(table) < 2:
+        return
+    with _state_lock:
+        if table not in _declared_tables:
+            _declared_tables.append(table)
+        for i in range(len(table)):
+            for j in range(i + 1, len(table)):
+                _declared_edges.add((table[i], table[j]))
+
+
+def path_exists(edges, src: str, dst: str) -> bool:
+    """Transitive reachability over an edge set (BFS) — shared by the
+    runtime order check here and the static ``lock-order`` rule
+    (analysis/concurrency.py), so the two analyses agree on what
+    "declared before" means."""
+    if src == dst:
+        return True
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        here = frontier.pop()
+        for a, b in edges:
+            if a == here and b not in seen:
+                if b == dst:
+                    return True
+                seen.add(b)
+                frontier.append(b)
+    return False
+
+
+def _declared_path(src: str, dst: str) -> bool:
+    """Reachability in the declared order; caller holds ``_state_lock``."""
+    return path_exists(_declared_edges, src, dst)
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def violations() -> List[Dict]:
+    """Violation records accumulated this process (receipts are also on
+    disk); the serving fast slice asserts this stays empty."""
+    with _state_lock:
+        return list(_violations)
+
+
+def reset_observed() -> None:
+    """Test hook: clear observed edges + recorded violations.  Declared
+    tables persist — they are import-time facts, not run state."""
+    with _state_lock:
+        _observed_edges.clear()
+        _violations.clear()
+
+
+def _receipt_path() -> str:
+    return os.environ.get(ENV_RECEIPT, DEFAULT_RECEIPT)
+
+
+def _record_violation(kind: str, held: str, acquiring: str,
+                      message: str) -> None:
+    """Write the receipt durably, remember the violation, raise."""
+    with _state_lock:
+        doc = {
+            "schema": LOCKSAN_SCHEMA,
+            "kind": kind,
+            "edge": [held, acquiring],
+            "thread": threading.current_thread().name,
+            "held_stack": list(_held_stack()),
+            "message": message,
+            "declared_tables": [list(t) for t in _declared_tables],
+            "observed_edges": sorted(
+                [list(e) for e in _observed_edges]),
+        }
+        _violations.append(doc)
+    # Durable receipt OUTSIDE the state lock: atomic_json_write fsyncs,
+    # and nothing below needs the registries again.
+    try:
+        from ..resilience.integrity import atomic_json_write
+
+        atomic_json_write(_receipt_path(), doc, indent=2)
+    except OSError:
+        pass  # a full disk must not mask the violation — the raise below
+    raise LockOrderViolation(f"lock-order violation ({kind}): {message}")
+
+
+def _check_edge(held: str, acquiring: str) -> None:
+    """Assert one dynamic acquisition edge against the declared order.
+    Called BEFORE blocking on the target lock, so a would-be deadlock is
+    reported instead of entered."""
+    with _state_lock:
+        if _declared_path(acquiring, held):
+            kind, msg = "inverted-order", (
+                f"acquiring '{acquiring}' while holding '{held}' "
+                "inverts the declared LOCK_ORDER "
+                f"(declared: {acquiring} before {held})")
+        elif not _declared_path(held, acquiring):
+            kind, msg = "undeclared-edge", (
+                f"acquiring '{acquiring}' while holding '{held}' is not "
+                "covered by any declared LOCK_ORDER table — declare the "
+                "pair (analysis/concurrency.py grammar) or break the "
+                "nesting")
+        else:
+            _observed_edges.setdefault(
+                (held, acquiring),
+                {"thread": threading.current_thread().name})
+            return
+    _record_violation(kind, held, acquiring, msg)
+
+
+class _SanitizedLock:
+    """``threading.Lock`` twin that runs every acquisition through the
+    order check.  Context-manager and acquire/release compatible with the
+    subset of the Lock API this tree uses."""
+
+    __slots__ = ("name", "_lk")
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._lk = threading.Lock()
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        for held in list(_held_stack()):
+            _check_edge(held, self.name)
+        got = self._lk.acquire(blocking, timeout)
+        if got:
+            _held_stack().append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        # Remove the most recent occurrence from THIS thread's stack:
+        # same-thread releases may legally be non-LIFO.  The sanitizer
+        # assumes same-thread release (every project use is a with
+        # block); a cross-thread handoff release would leave the
+        # acquirer's stack stale — don't wrap such a lock.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == self.name:
+                del stack[i]
+                break
+        self._lk.release()
+
+    def locked(self) -> bool:
+        return self._lk.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self.name!r} at {id(self):#x}>"
+
+
+def named_lock(name: str):
+    """The project's lock factory (the static lock-order rule resolves
+    ``with``-acquisitions to canonical lock names through assignments
+    from this call).  Plain ``threading.Lock`` unless the sanitizer env
+    flag is set when the lock is CREATED."""
+    if enabled():
+        return _SanitizedLock(name)
+    return threading.Lock()

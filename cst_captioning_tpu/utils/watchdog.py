@@ -82,7 +82,10 @@ class ProgressWatchdog:
         self._hb_interval = float(heartbeat_interval_s or 0.0)
         self._last = time.monotonic()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # The monitor thread never manages its own lifecycle: only the
+        # controlling thread may start/join/replace it
+        # (cstlint:thread-ownership).
+        self._thread: Optional[threading.Thread] = None  # cstlint: owned_by=control
 
     def _armed(self) -> bool:
         return self.timeout_s > 0 or (

@@ -81,13 +81,13 @@ print("TRACED 5 fused steps on", jax.devices()[0].platform)
 def run_step(name: str, cmd: list, out_dir: str, timeout_s: float,
              log: list, env: dict | None = None) -> bool:
     path = os.path.join(out_dir, f"{name}.out")
-    t0 = time.time()
+    t0 = time.monotonic()
     with open(path, "w") as f:
         info: dict = {}
         rc = run_in_group(cmd, cwd=REPO, timeout=timeout_s, env=env,
                           stdout=f, stderr=f, timeout_info=info)
     entry = {"step": name, "rc": rc, "timed_out": info["timed_out"],
-             "seconds": round(time.time() - t0, 1), "output": path}
+             "seconds": round(time.monotonic() - t0, 1), "output": path}
     log.append(entry)
     print(json.dumps(entry), flush=True)
     return rc == 0
@@ -109,15 +109,18 @@ def main() -> int:
     args = ap.parse_args()
     os.makedirs(args.out_dir, exist_ok=True)
 
-    deadline = time.time() + args.max_wait
-    waited_from = time.time()
+    # Monotonic: the max-wait window spans hours on a box whose wall
+    # clock the tunnel host may step (cstlint:monotonic-deadline).
+    deadline = time.monotonic() + args.max_wait
+    waited_from = time.monotonic()
     while True:
         verdict, detail = probe_device(args.probe_timeout)
         if verdict == "broken":
             print(f"environment broken, not wedged: {detail}", flush=True)
             return 2
         if verdict == "ok":
-            print(f"device healthy after {time.time() - waited_from:.0f}s; "
+            print(f"device healthy after "
+                  f"{time.monotonic() - waited_from:.0f}s; "
                   f"grace {args.grace_s:.0f}s for the scale chain",
                   flush=True)
             time.sleep(args.grace_s)
@@ -129,11 +132,11 @@ def main() -> int:
                 break
             print("window closed during the grace period; back to polling",
                   flush=True)
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             print(f"no healthy window within {args.max_wait / 3600:.1f}h",
                   flush=True)
             return 3
-        print(f"wedged ({time.time() - waited_from:.0f}s); "
+        print(f"wedged ({time.monotonic() - waited_from:.0f}s); "
               f"retry in {args.poll_s:.0f}s", flush=True)
         time.sleep(args.poll_s)
 

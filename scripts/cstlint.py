@@ -6,7 +6,10 @@ enforcement surface (cst_captioning_tpu/, scripts/, the top-level CLIs)
 and reports every unsuppressed violation of the repo's hard-won
 invariants: device-scalar fetches in hot loops, durable JSON writes
 bypassing atomic_json_write, undeclared counters, untyped exits,
-silent exception swallows, and donated-but-unaliased jit buffers.
+silent exception swallows, donated-but-unaliased jit buffers, and the
+concurrency contracts (guarded-by/ownership annotations, LOCK_ORDER
+embedding, signal-handler safety, thread discipline, monotonic
+deadlines — ANALYSIS.md "Concurrency contracts").
 
 Usage:
   python scripts/cstlint.py                 # human output, full tree
@@ -64,8 +67,13 @@ def main() -> int:
     )
 
     if args.list_rules:
+        by_cat = {}
         for name in sorted(RULES):
-            print(f"{name:22s} {RULES[name].doc}")
+            by_cat.setdefault(RULES[name].category, []).append(name)
+        for cat in sorted(by_cat):
+            print(f"[{cat}]")
+            for name in by_cat[cat]:
+                print(f"  {name:22s} {RULES[name].doc}")
         return EXIT_OK
 
     rules = None

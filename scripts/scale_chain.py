@@ -289,13 +289,16 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
         print(f"=== {tag}: wedge (rc={rc}); polling for the device "
               f"every {wedge_poll_s:.0f}s ===", flush=True)
         events.emit("wedge", tag=tag, rc=rc, attempt=attempt)
-        wedge_t0 = time.time()
+        # Monotonic, not wall clock: an NTP step during the hours-long
+        # heal wait must not shrink or stretch the deadline
+        # (cstlint:monotonic-deadline).
+        wedge_t0 = time.monotonic()
         deadline = wedge_t0 + max_wedge_wait_s
         healed = False
         observed_wedged = known_wedged
         if known_wedged:
             time.sleep(wedge_poll_s)  # just probed wedged; wait first
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if probe() == "ok":
                 healed = True
                 break
@@ -307,7 +310,7 @@ def run_stage(tag: str, cmd: list, *, max_attempts: int,
                 f"stage {tag}: device did not heal within "
                 f"{max_wedge_wait_s / 3600:.1f}h; giving up")
         events.emit("healed", tag=tag,
-                    waited_s=round(time.time() - wedge_t0, 1))
+                    waited_s=round(time.monotonic() - wedge_t0, 1))
         # Attempt accounting AFTER the facts are in: progress resets the
         # cap; an attempt that died while the device was observably down
         # proves nothing about the stage and does not count; only
@@ -379,7 +382,7 @@ def generate_data(root: str, num_videos: int, num_val: int,
             # a supervisor never retries what only a human can resolve.
             raise SystemExit(exitcodes.EXIT_USAGE)
     os.makedirs(root, exist_ok=True)
-    t0 = time.time()
+    t0 = time.monotonic()
     spec = SyntheticSpec(
         num_videos=num_videos, captions_per_video=20, max_len=30,
         feat_dims=tuple(feat_dims), feat_times=tuple(feat_times),
@@ -398,7 +401,7 @@ def generate_data(root: str, num_videos: int, num_val: int,
     # readable half-written, or a resumed chain would trust a torn spec.
     atomic_json_write(marker + ".paths", paths)
     atomic_json_write(marker, spec_dict)
-    print(f"dataset generated in {time.time() - t0:.0f}s -> {root}")
+    print(f"dataset generated in {time.monotonic() - t0:.0f}s -> {root}")
     return paths
 
 

@@ -1,0 +1,27 @@
+"""Near-miss negative: every access holds the declared lock, a *_locked
+helper relies on the caller-holds-it convention, and an UNannotated
+attribute may roam free."""
+
+from cst_captioning_tpu.analysis.locksan import named_lock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = named_lock("corpus.registry")
+        self._counters = {}  # cstlint: guarded_by=self._lock
+        self._sinks = []     # unannotated: not shared, no rule applies
+
+    def inc(self, name):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._take_locked()
+
+    def _take_locked(self):
+        # *_locked convention: the caller holds self._lock.
+        return dict(self._counters)
+
+    def add_sink(self, sink):
+        self._sinks.append(sink)

@@ -1,0 +1,17 @@
+"""Positive: a guarded_by-annotated attribute read and written outside
+its declared lock."""
+
+from cst_captioning_tpu.analysis.locksan import named_lock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = named_lock("corpus.registry")
+        self._counters = {}  # cstlint: guarded_by=self._lock
+
+    def inc(self, name):
+        # No lock held: two threads lose increments here.
+        self._counters[name] = self._counters.get(name, 0) + 1
+
+    def snapshot(self):
+        return dict(self._counters)

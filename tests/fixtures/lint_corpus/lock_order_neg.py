@@ -1,0 +1,37 @@
+"""Near-miss negative: nesting that embeds into the declared order
+(including transitively), sequential (non-nested) acquisitions, and a
+with on a non-lock context manager."""
+
+from cst_captioning_tpu.analysis.locksan import declare_order, named_lock
+
+LOCK_ORDER = ("corpus2.a", "corpus2.b", "corpus2.c")
+declare_order(*LOCK_ORDER)
+
+_A = named_lock("corpus2.a")
+_B = named_lock("corpus2.b")
+_C = named_lock("corpus2.c")
+
+
+def declared_nesting():
+    with _A:
+        with _B:
+            pass
+
+
+def transitive_nesting():
+    with _A:
+        with _C:  # a < c follows from the table
+            pass
+
+
+def sequential_is_free():
+    with _B:
+        pass
+    with _A:  # no lock held: order-free
+        pass
+
+
+def non_lock_context(path):
+    with _A:
+        with open(path) as f:  # not a lock acquisition
+            return f.read()

@@ -1,0 +1,27 @@
+"""Positive: a nested acquisition that inverts the declared LOCK_ORDER,
+plus a nesting of unnamed locks neither analysis can check."""
+
+import threading
+
+from cst_captioning_tpu.analysis.locksan import declare_order, named_lock
+
+LOCK_ORDER = ("corpus.outer", "corpus.inner")
+declare_order(*LOCK_ORDER)
+
+_OUTER = named_lock("corpus.outer")
+_INNER = named_lock("corpus.inner")
+
+_raw_lock_a = threading.Lock()
+_raw_lock_b = threading.Lock()
+
+
+def inverted():
+    with _INNER:
+        with _OUTER:  # declared outer-before-inner; this is the deadlock
+            pass
+
+
+def anonymous_pair():
+    with _raw_lock_a:
+        with _raw_lock_b:  # neither lock is named/declared
+            pass

@@ -1,0 +1,22 @@
+"""Near-miss negative: monotonic deadlines, plus the legal wall-clock
+uses — bare timestamp reads stored into records (no arithmetic)."""
+
+import time
+
+
+def wait_for(probe, max_wait_s):
+    deadline = time.monotonic() + max_wait_s
+    while time.monotonic() < deadline:
+        if probe():
+            return True
+    return False
+
+
+def stamp(event):
+    # Wall-clock TIMESTAMPS are fine: they label, they do not wait.
+    return {"ts": time.time(), "event": event}
+
+
+def snapshot_time():
+    now = time.time()
+    return now

@@ -1,0 +1,16 @@
+"""Positive: deadline construction, polling comparison, and elapsed
+arithmetic on the wall clock."""
+
+import time
+
+
+def wait_for(probe, max_wait_s):
+    deadline = time.time() + max_wait_s
+    while time.time() < deadline:
+        if probe():
+            return True
+    return False
+
+
+def elapsed(t0):
+    return time.time() - t0

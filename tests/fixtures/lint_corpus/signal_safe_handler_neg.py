@@ -1,0 +1,27 @@
+"""Near-miss negative: the PR 4 shape — plain-bool flag + os.write in
+the handler; the Event.set lives in code NOT reachable from it."""
+
+import os
+import signal
+import threading
+import time
+
+
+class Handler:
+    def __init__(self):
+        self._requested = False
+        self._evt = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        # GIL-atomic attribute write + raw fd write: async-signal-safe.
+        self._requested = True
+        self._when = time.monotonic()
+        os.write(2, b"PREEMPT\n")
+
+    def stop_event_from_main_thread(self):
+        # Same unsafe calls, but NOT reachable from the handler.
+        self._evt.set()
+        print("stopping")

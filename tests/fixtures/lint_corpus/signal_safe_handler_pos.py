@@ -1,0 +1,28 @@
+"""Positive: a signal handler (and a helper it calls) doing
+non-async-signal-safe work — Event.set, logging, print."""
+
+import logging
+import signal
+import threading
+
+log = logging.getLogger(__name__)
+
+
+class Handler:
+    def __init__(self):
+        self._evt = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        # Event.set() takes a non-reentrant lock: a nested signal at the
+        # next bytecode boundary deadlocks the main thread.
+        self._evt.set()
+        self._note(signum)
+
+    def _note(self, signum):
+        # Reachable FROM the handler: the logging module lock may be
+        # held by the interrupted thread.
+        log.warning("signal %s", signum)
+        print("got signal", signum)

@@ -1,0 +1,18 @@
+"""Near-miss negative: every thread is named with explicit daemonhood,
+and the non-daemon one has a reachable join."""
+
+import threading
+
+
+def work():
+    pass
+
+
+def spawn_daemon():
+    threading.Thread(target=work, name="prefetch", daemon=True).start()
+
+
+def spawn_and_reap():
+    t = threading.Thread(target=work, name="flusher", daemon=False)
+    t.start()
+    t.join(timeout=5.0)

@@ -1,0 +1,24 @@
+"""Positive: anonymous thread, implicit daemonhood, and a non-daemon
+thread nobody ever joins."""
+
+import threading
+
+
+def work():
+    pass
+
+
+def spawn_anonymous():
+    threading.Thread(target=work, daemon=True).start()
+
+
+def spawn_implicit_daemon():
+    threading.Thread(target=work, name="worker").start()
+
+
+def spawn_unreaped():
+    t = threading.Thread(target=work, name="leaky", daemon=False)
+    t.start()
+    # A STRING join must not satisfy the reap-site check — only a
+    # Thread-shaped .join() (no args / numeric timeout) counts.
+    return ", ".join(str(t) for t in [t])

@@ -1,0 +1,33 @@
+"""Near-miss negative: reader threads only touch the inbox; the owner
+touches its own state from non-target methods; a closure defined inside
+the target (but executed by the scheduler) may touch owned state."""
+
+import queue
+import threading
+
+
+class Server:
+    def __init__(self, engine):
+        self.engine = engine  # cstlint: owned_by=scheduler
+        self._inbox = queue.Queue()
+
+    def run(self):
+        def read():
+            # Reader thread: parse into the inbox, never the engine.
+            for line in iter(input, ""):
+                def respond(obj):
+                    # Defined inside the target but invoked by the
+                    # scheduler loop: owned-state access is legal here.
+                    self.engine.note(obj)
+
+                self._inbox.put((line, respond))
+
+        threading.Thread(target=read, name="reader", daemon=True).start()
+        self.loop()
+
+    def loop(self):
+        # The scheduler loop IS the owner.
+        while not self._inbox.empty():
+            line, respond = self._inbox.get_nowait()
+            self.engine.submit(line)
+            respond(line)

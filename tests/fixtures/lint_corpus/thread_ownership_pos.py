@@ -1,0 +1,33 @@
+"""Positive: a reader thread reaches into scheduler-owned state instead
+of handing work through the inbox."""
+
+import queue
+import threading
+
+
+class Server:
+    def __init__(self, engine):
+        self.engine = engine  # cstlint: owned_by=scheduler
+        self._inbox = queue.Queue()
+
+    def start(self):
+        threading.Thread(target=self.reader_main, name="reader",
+                         daemon=True).start()
+
+
+def reader_main(self):
+    for line in iter(input, ""):
+        # The violation: submitting straight into the engine from the
+        # reader thread, bypassing the inbox.
+        self.engine.submit(line)
+
+
+class Spawner:
+    def __init__(self, engine):
+        self.engine = engine  # cstlint: owned_by=scheduler
+
+    def run(self):
+        def read():
+            self.engine.submit("direct")  # owned state, reader thread
+
+        threading.Thread(target=read, name="conn", daemon=True).start()

@@ -43,6 +43,21 @@ AST_CORPUS = {
     "exit-taxonomy": ("exit_taxonomy", "scripts/somescript.py"),
     "bare-except-swallow": ("bare_except",
                             "cst_captioning_tpu/serving/somemodule.py"),
+    # Concurrency contracts (ISSUE 11; ANALYSIS.md "Concurrency
+    # contracts") — all six are tree-wide or annotation-scoped, so any
+    # virtual path works; these mirror where each rule's real catches
+    # live.
+    "guarded-by": ("guarded_by",
+                   "cst_captioning_tpu/telemetry/somemodule.py"),
+    "thread-ownership": ("thread_ownership",
+                         "cst_captioning_tpu/serving/somemodule.py"),
+    "lock-order": ("lock_order",
+                   "cst_captioning_tpu/serving/somemodule.py"),
+    "signal-safe-handler": ("signal_safe_handler",
+                            "cst_captioning_tpu/resilience/somemodule.py"),
+    "thread-discipline": ("thread_discipline",
+                          "cst_captioning_tpu/data/somemodule.py"),
+    "monotonic-deadline": ("monotonic_deadline", "scripts/somescript.py"),
 }
 
 
@@ -92,6 +107,81 @@ def test_bare_except_scoped_to_failure_domains():
     text = corpus_text("bare_except", "pos")
     assert run_rule("bare-except-swallow", text,
                     "cst_captioning_tpu/metrics/ngrams.py") == []
+
+
+# -- concurrency contracts (ISSUE 11) --------------------------------------
+
+
+def test_guarded_by_flags_both_access_kinds():
+    """The positive's unlocked read AND write both fire."""
+    hits = run_rule("guarded-by", corpus_text("guarded_by", "pos"),
+                    "cst_captioning_tpu/telemetry/somemodule.py")
+    assert len(hits) >= 2
+    assert all("guarded_by=self._lock" in h.message for h in hits)
+
+
+def test_lock_order_positive_diagnoses_inversion_and_unnamed():
+    hits = run_rule("lock-order", corpus_text("lock_order", "pos"),
+                    "cst_captioning_tpu/serving/somemodule.py")
+    msgs = " | ".join(h.message for h in hits)
+    assert "INVERTS" in msgs
+    assert "unnamed locks" in msgs
+
+
+def test_lock_order_cycle_across_conflicting_tables():
+    """Two modules declaring opposite orders for the same pair: the
+    nested acquisition that closes the loop is a cycle violation even
+    though each table alone is consistent."""
+    a = ('from cst_captioning_tpu.analysis.locksan import named_lock\n'
+         'LOCK_ORDER = ("cyc.a", "cyc.b")\n'
+         '_A = named_lock("cyc.a")\n'
+         '_B = named_lock("cyc.b")\n'
+         'def f():\n'
+         '    with _A:\n'
+         '        with _B:\n'
+         '            pass\n')
+    b = ('from cst_captioning_tpu.analysis.locksan import named_lock\n'
+         'LOCK_ORDER = ("cyc.b", "cyc.a")\n')
+    res = lint_sources(
+        [("cst_captioning_tpu/serving/a.py", a),
+         ("cst_captioning_tpu/serving/b.py", b)],
+        rules=["lock-order"])
+    msgs = " | ".join(v.message for v in res.violations)
+    assert "INVERTS" in msgs or "cycle" in msgs
+
+
+def test_signal_safe_handler_resolves_lambda_registration():
+    """scale_chain's lambda handler shape: sys.exit through a constant
+    is allowed; an Event.set in the lambda is not."""
+    ok = ('import signal, sys\n'
+          'from x import EXIT_SIGTERM\n'
+          'signal.signal(signal.SIGTERM,\n'
+          '              lambda *_: sys.exit(EXIT_SIGTERM))\n')
+    assert run_rule("signal-safe-handler", ok, "scripts/somescript.py") == []
+    bad = ('import signal, threading\n'
+           'EVT = threading.Event()\n'
+           'signal.signal(signal.SIGTERM, lambda *_: EVT.set())\n')
+    hits = run_rule("signal-safe-handler", bad, "scripts/somescript.py")
+    assert hits and ".set()" in hits[0].message
+
+
+def test_thread_discipline_counts_three_distinct_failures():
+    hits = run_rule("thread-discipline",
+                    corpus_text("thread_discipline", "pos"),
+                    "cst_captioning_tpu/data/somemodule.py")
+    msgs = [h.message for h in hits]
+    assert any("without name=" in m for m in msgs)
+    assert any("explicit daemon=" in m for m in msgs)
+    assert any("no .join()" in m for m in msgs)
+
+
+def test_monotonic_deadline_allows_bare_timestamps():
+    """`{"ts": time.time()}` and `now = time.time()` are legal: the rule
+    bans arithmetic/comparisons, not wall-clock labels."""
+    hits = run_rule("monotonic-deadline",
+                    corpus_text("monotonic_deadline", "neg"),
+                    "cst_captioning_tpu/utils/somemodule.py")
+    assert hits == []
 
 
 # -- donation audit (jaxpr-level) ------------------------------------------
@@ -230,8 +320,32 @@ def test_render_json_schema():
 
 def test_every_shipped_rule_registered():
     expected = {"device-scalar-fetch", "atomic-write", "declared-counters",
-                "exit-taxonomy", "bare-except-swallow", "donation-audit"}
+                "exit-taxonomy", "bare-except-swallow", "donation-audit",
+                "guarded-by", "thread-ownership", "lock-order",
+                "signal-safe-handler", "thread-discipline",
+                "monotonic-deadline"}
     assert expected <= set(RULES)
+    for name in ("guarded-by", "thread-ownership", "lock-order",
+                 "signal-safe-handler", "thread-discipline",
+                 "monotonic-deadline"):
+        assert RULES[name].category == "concurrency"
+
+
+def test_lint_json_carries_concurrency_rules_zero_schema_change():
+    """Satellite pin: collect_evidence's bundled lint.json picks the new
+    rules up through `rules_ran` with NO schema change — same schema 1,
+    same top-level keys the MANIFEST contract reads."""
+    res = lint_tree(REPO, trace=False,
+                    paths=["cst_captioning_tpu/resilience/exitcodes.py"])
+    import json as _json
+
+    doc = _json.loads(render_json(res))
+    assert doc["schema"] == 1
+    assert set(doc) == {"schema", "clean", "files_scanned", "rules_ran",
+                        "summary", "violations", "suppressed"}
+    assert {"guarded-by", "thread-ownership", "lock-order",
+            "signal-safe-handler", "thread-discipline",
+            "monotonic-deadline"} <= set(doc["rules_ran"])
 
 
 # -- CLI contract ----------------------------------------------------------
@@ -258,9 +372,26 @@ def test_cli_clean_subset_exits_ok():
 
 
 def test_cli_unknown_rule_is_usage_error():
+    """Satellite pin: a bad --rules token exits 2 (usage) with a
+    one-line error NAMING the bad rule, not a stack trace."""
     p = _run_cli("--rules", "no-such-rule")
     assert p.returncode == EXIT_USAGE
     assert "unknown rule" in p.stderr
+    assert "no-such-rule" in p.stderr
+    assert "Traceback" not in p.stderr
+
+
+def test_cli_list_rules_groups_by_category():
+    p = _run_cli("--list-rules")
+    assert p.returncode == EXIT_OK
+    out = p.stdout
+    assert "[concurrency]" in out and "[core]" in out
+    # The concurrency block lists the six contracts together.
+    conc = out.split("[concurrency]")[1].split("[core]")[0]
+    for name in ("guarded-by", "thread-ownership", "lock-order",
+                 "signal-safe-handler", "thread-discipline",
+                 "monotonic-deadline"):
+        assert name in conc
 
 
 def test_cli_violations_exit_failure(tmp_path):

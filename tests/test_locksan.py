@@ -1,0 +1,173 @@
+"""Runtime lock sanitizer (ISSUE 11): the dynamic cross-check on the
+declared LOCK_ORDER.
+
+Pins the acceptance contract:
+- disabled (default) the factory returns plain ``threading.Lock`` — zero
+  overhead, zero behavior change;
+- armed, acquisitions that follow a declared table pass and record their
+  edges;
+- a DELIBERATELY mis-declared order produces the violation receipt: a
+  durable JSON written through ``atomic_json_write`` naming the edge,
+  the holder's stack, and the declared tables — and raises
+  :class:`LockOrderViolation` BEFORE blocking on the lock that would
+  deadlock;
+- undeclared nestings are violations too (the "static declarations rot"
+  failure mode) — and since edges are only ever recorded when declared,
+  those two checks catch every would-be cross-thread cycle at one of
+  its edges;
+- the serving plane's shipped tables (server write->conn,
+  ProgramCache->registry) are registered at import time.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from cst_captioning_tpu.analysis import locksan
+from cst_captioning_tpu.analysis.locksan import (
+    LockOrderViolation,
+    declare_order,
+    named_lock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _armed(monkeypatch, tmp_path):
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    locksan.reset_observed()
+    yield receipt
+    locksan.reset_observed()
+
+
+def test_disabled_factory_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv(locksan.ENV_FLAG, raising=False)
+    lk = named_lock("ls.plain")
+    assert isinstance(lk, type(threading.Lock()))
+
+
+def test_runtime_import_is_lint_engine_free():
+    """The implementation lives in utils/ so runtime lock creators never
+    pull the lint machinery: importing utils.locksan (what telemetry/
+    serving/native do) must leave the analysis package unloaded;
+    analysis.locksan is the re-exporting façade."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import cst_captioning_tpu.utils.locksan as ls\n"
+        "bad = [m for m in sys.modules if 'analysis' in m]\n"
+        "assert not bad, f'lint engine leaked into runtime import: {bad}'\n"
+        "import cst_captioning_tpu.analysis.locksan as facade\n"
+        "assert facade.named_lock is ls.named_lock\n"
+        "assert facade.declare_order is ls.declare_order\n")
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode == 0, p.stderr
+
+
+def test_armed_factory_returns_sanitized_lock():
+    lk = named_lock("ls.sanitized")
+    assert lk.__class__.__name__ == "_SanitizedLock"
+    assert "ls.sanitized" in repr(lk)
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_declared_order_passes_and_records_edges():
+    declare_order("ls.ok.a", "ls.ok.b")
+    a, b = named_lock("ls.ok.a"), named_lock("ls.ok.b")
+    with a:
+        with b:
+            pass
+    assert locksan.violations() == []
+
+
+def test_misdeclared_order_produces_receipt(_armed):
+    """THE acceptance drill: the declared table says b-before-a, the
+    code nests a->b — the sanitizer refuses the acquisition, writes the
+    durable receipt, and raises."""
+    declare_order("ls.bad.b", "ls.bad.a")
+    a, b = named_lock("ls.bad.a"), named_lock("ls.bad.b")
+    with pytest.raises(LockOrderViolation, match="inverts the declared"):
+        with a:
+            with b:
+                pass
+    doc = json.loads(_armed.read_text())
+    assert doc["schema"] == locksan.LOCKSAN_SCHEMA
+    assert doc["kind"] == "inverted-order"
+    assert doc["edge"] == ["ls.bad.a", "ls.bad.b"]
+    assert "ls.bad.a" in doc["held_stack"]
+    assert ["ls.bad.b", "ls.bad.a"] in doc["declared_tables"]
+    assert locksan.violations()[-1]["kind"] == "inverted-order"
+
+
+def test_undeclared_nesting_is_a_violation(_armed):
+    a, c = named_lock("ls.und.a"), named_lock("ls.und.c")
+    with pytest.raises(LockOrderViolation, match="not covered by any"):
+        with a:
+            with c:
+                pass
+    assert json.loads(_armed.read_text())["kind"] == "undeclared-edge"
+
+
+def test_contradictory_tables_fail_both_directions_across_threads():
+    """Two modules declaring opposite orders for one pair: EVERY nesting
+    of that pair is refused, on any thread, before it can block — the
+    deadlock is reported instead of entered."""
+    declare_order("ls.cyc.x", "ls.cyc.y")
+    declare_order("ls.cyc.y", "ls.cyc.x")   # the contradictory table
+    x, y = named_lock("ls.cyc.x"), named_lock("ls.cyc.y")
+    caught = []
+
+    def nest_xy():
+        try:
+            with x:
+                with y:
+                    pass
+        except LockOrderViolation as e:
+            caught.append(e)
+
+    t = threading.Thread(target=nest_xy, name="locksan-test-xy",
+                         daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive() and len(caught) == 1
+    with pytest.raises(LockOrderViolation):
+        with y:
+            with x:
+                pass
+
+
+def test_release_out_of_lifo_order_is_legal():
+    declare_order("ls.fifo.a", "ls.fifo.b")
+    a, b = named_lock("ls.fifo.a"), named_lock("ls.fifo.b")
+    a.acquire()
+    b.acquire()
+    a.release()           # handoff pattern: outer released first
+    b.release()
+    assert locksan.violations() == []
+
+
+def test_shipped_serving_tables_are_registered():
+    """Importing the serving plane declares its LOCK_ORDER tables — the
+    same declaration the static rule reads (one source of truth)."""
+    from cst_captioning_tpu.serving import buckets, server
+
+    assert buckets.LOCK_ORDER == ("serving.programs", "telemetry.registry")
+    assert server.LOCK_ORDER == ("serving.server.write",
+                                 "serving.server.conn")
+    # And the runtime registry honors them end to end.
+    progs = named_lock("serving.programs")
+    reg = named_lock("telemetry.registry")
+    with progs:
+        with reg:
+            pass
+    assert locksan.violations() == []

@@ -63,6 +63,30 @@ V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """ISSUE 11: the serving fast slice runs with the runtime lock
+    sanitizer ARMED — every engine/server/registry built inside a test
+    gets sanitized locks, so the declared LOCK_ORDER is re-validated
+    under the PR 9 fault drills on every tier-1 run.  A violation raises
+    in place; this fixture additionally asserts none were recorded."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    # Subprocess drills (scripts/serve.py) inherit the env: their
+    # violations can't reach this process's registry, but the durable
+    # receipt can — its absence IS the cross-process assertion.
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt from a child process: "
+        f"{receipt.read_text()}")
+
+
 class FakeClock:
     def __init__(self):
         self.t = 0.0
@@ -460,6 +484,66 @@ def test_health_op_reports_ok_degraded_draining(server):
     srv._handle_line('{"op": "health"}', respond)
     assert replies[-1]["status"] == "draining"
     assert registry.snapshot()["counters"]["serve_health_queries"] == 3
+
+
+def test_socket_reader_thread_lifecycle(server):
+    """Satellite (ISSUE 11): the socket front end's reader-thread
+    lifecycle, in-process and tier-1 — two connections interleave
+    requests (their responses serialize through ``_write_lock`` under
+    the armed lock sanitizer), one disconnects MID-LINE (the torn tail
+    is a counted bad line, its error answer hits a dead socket and is
+    absorbed), and EOF shutdown leaves no stray serve-* thread behind."""
+    import socket as socketlib
+    import threading
+
+    from cst_captioning_tpu.resilience.exitcodes import EXIT_OK
+
+    srv, registry, replies, respond = server
+    rc = []
+    loop = threading.Thread(target=lambda: rc.append(srv.run_socket(0)),
+                            name="serve-loop-under-test", daemon=True)
+    loop.start()
+    deadline = time.monotonic() + 30.0
+    while srv.bound_port is None:
+        assert time.monotonic() < deadline, "server never bound"
+        time.sleep(0.01)
+
+    def rpc(sock, fh, obj):
+        sock.sendall((json.dumps(obj) + "\n").encode())
+        return json.loads(fh.readline())
+
+    c1 = socketlib.create_connection(("127.0.0.1", srv.bound_port),
+                                     timeout=30)
+    c2 = socketlib.create_connection(("127.0.0.1", srv.bound_port),
+                                     timeout=30)
+    with c1, c2, c1.makefile("r") as f1, c2.makefile("r") as f2:
+        # Interleaved requests across the two reader threads: each
+        # response must land on ITS connection, whole-line.
+        assert rpc(c1, f1, {"id": "a0", "video_id": "v0"})["id"] == "a0"
+        assert rpc(c2, f2, {"id": "b0", "video_id": "v1"})["id"] == "b0"
+        assert rpc(c1, f1, {"id": "a1", "video_id": "v2"})["id"] == "a1"
+        assert rpc(c2, f2, {"id": "b1", "video_id": "nope"}
+                   )["error"] == "unknown_video"
+        bad0 = registry.counter("serve_bad_lines")
+        # Disconnect MID-LINE: the torn tail reaches the scheduler as a
+        # malformed line; its error answer goes to a closed socket.
+        c2.sendall(b'{"id": "torn')
+        c2.shutdown(socketlib.SHUT_RDWR)
+    deadline = time.monotonic() + 30.0
+    while registry.counter("serve_bad_lines") <= bad0:
+        assert time.monotonic() < deadline, "torn line never counted"
+        time.sleep(0.01)
+    # Natural end: EOF with everything answered and the engine idle.
+    srv._eof.set()
+    loop.join(timeout=60.0)
+    assert rc == [EXIT_OK]
+    deadline = time.monotonic() + 10.0
+    while any(t.name in ("serve-conn", "serve-accept")
+              for t in threading.enumerate()):
+        assert time.monotonic() < deadline, (
+            f"stray serving threads: "
+            f"{[t.name for t in threading.enumerate()]}")
+        time.sleep(0.05)
 
 
 def test_expired_request_gets_explicit_response(long_setup):
