@@ -33,7 +33,8 @@ TRAIN_COMMON = \
 
 .PHONY: test lint lint-json chaos xe wxe cst cst_scb cst_host eval bench \
         demo trace-demo scale_chain report collect chip_window tune \
-        tune-fast tune-report serve-demo serve-bench serve-chaos clean
+        tune-fast tune-report serve-demo serve-bench serve-stream-bench \
+        serve-chaos bf16-parity clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -180,11 +181,40 @@ serve-demo:
 # window run `python bench.py --stage serving` bare for the full-shape
 # cached number.
 serve-bench:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py \
+	  tests/test_serving_stream.py -q
 	JAX_PLATFORMS=cpu $(PY) bench.py --stage serving --platform cpu --cache 0 \
 	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
 	  --serve_requests 12 --serve_rate 6 > /tmp/cst_serve_bench.json
 	$(PY) scripts/serve_report.py --file /tmp/cst_serve_bench.json
+
+# Streaming + result-cache probe (SERVING.md "Streaming & result
+# cache"): the zipfian open-loop Poisson probe with streaming ON and the
+# exact-result cache armed, plus its cache-OFF twin in the same run.
+# The probe itself asserts zero post-warmup compiles and stream prefix
+# consistency (a violation raises, so no JSON line is emitted);
+# serve_report renders TTFT / inter-chunk-gap / hit-rate rows and exits
+# 1 if any cache hit is not bit-identical to its miss twin, or the
+# cached run does not beat the twin on captions/s.  The fast API slice
+# of this probe rides tier-1 (tests/test_serving_stream.py).
+serve-stream-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --stage serving --platform cpu --cache 0 \
+	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
+	  --serve_requests 32 --serve_rate 300 --serve_stream 1 --serve_cache 16 \
+	  --serve_unique 4 --serve_zipf 1.1 --serve_cache_compare 1 \
+	  --probe_eos_bias -4 \
+	  > /tmp/cst_serve_stream.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_stream.json
+
+# bf16 decode parity gate (ops/bf16_decode.py): CIDEr delta vs the fp32
+# decode of the same checkpoint, bounded; exit 1 (with 'reference'
+# pinned as the recommendation) outside the bound.  Bare target = the
+# zero-setup synthetic smoke; run against a real checkpoint with the
+# eval-style --checkpoint_path/--test_* flags for the record of
+# evidence.
+bf16-parity:
+	JAX_PLATFORMS=cpu $(PY) scripts/bf16_parity.py --synthetic 1 \
+	  --max_length 8 --beam_size 2 --loglevel WARNING
 
 # Serving chaos drills (RESILIENCE.md "Serving faults"): the seeded
 # serve_wedge/serve_garble/admit_err fault plans through the self-healing

@@ -471,14 +471,40 @@ def bench_serving(args) -> dict:
     params["logit"] = {**params["logit"]}
     params["logit"]["bias"] = (
         params["logit"]["bias"].at[0].add(args.probe_eos_bias))
-    out = serving_probe(
-        model, {"params": params}, [(28, 2048), (1, 4096)],
+    probe_kw = dict(
         num_requests=args.serve_requests, rate_hz=args.serve_rate,
         max_len=args.seq_len, beam_size=args.serve_beam,
         decode_chunk=axes["decode_chunk"],
         bucket_sizes=parse_buckets(args.serve_buckets),
         queue_limit=0, seed=777,
+        stream=bool(args.serve_stream),
+        cache_size=args.serve_cache,
+        unique_videos=args.serve_unique,
+        zipf_alpha=args.serve_zipf,
     )
+    shapes = [(28, 2048), (1, 4096)]
+    if args.serve_cache_compare and args.serve_cache:
+        # A small UNMEASURED rehearsal first: the process's first probe
+        # pays one-time warm-up (allocator/thread-pool first touch) that
+        # would otherwise land on whichever measured run goes first and
+        # fake a 2-3x gap between the twins.  Then the cache-OFF twin and
+        # the cached probe at the SAME seed (identical arrival schedule
+        # and zipfian mix) in the same bench run: the cached probe must
+        # beat the twin on captions/s or the cache is not paying —
+        # serve_report renders both and exits 1 when it doesn't.
+        serving_probe(model, {"params": params}, shapes,
+                      **{**probe_kw, "cache_size": 0, "num_requests": 8,
+                         "rate_hz": min(args.serve_rate, 100.0)})
+        twin = serving_probe(model, {"params": params}, shapes,
+                             **{**probe_kw, "cache_size": 0})
+        out = serving_probe(model, {"params": params}, shapes, **probe_kw)
+        out["cache_off_captions_per_sec"] = twin["captions_per_sec"]
+        out["cache_off_latency_p50_ms"] = twin["latency_p50_ms"]
+        if twin["captions_per_sec"] > 0:
+            out["cache_speedup"] = round(
+                out["captions_per_sec"] / twin["captions_per_sec"], 3)
+    else:
+        out = serving_probe(model, {"params": params}, shapes, **probe_kw)
     out["eos_bias"] = args.probe_eos_bias
     return out
 
@@ -523,12 +549,13 @@ def parse_args():
                         "the trainer's resolved default (tuning record, "
                         "else opts.py)")
     p.add_argument("--decode_kernel", default=None,
-                   choices=("reference", "pallas"),
+                   choices=("reference", "pallas", "bf16"),
                    help="decode-step cell for the CST rollout: the flax "
-                        "reference cell or the fused Pallas decode kernel "
-                        "(ops/pallas_decode_cell.py); default = the "
-                        "trainer's resolved default (tuning record, else "
-                        "'reference')")
+                        "reference cell, the fused Pallas decode kernel "
+                        "(ops/pallas_decode_cell.py), or the bf16 "
+                        "low-precision variant (ops/bf16_decode.py, "
+                        "parity-gated); default = the trainer's resolved "
+                        "default (tuning record, else 'reference')")
     p.add_argument("--serve_requests", type=int, default=24,
                    help="--stage serving: requests in the seeded Poisson "
                         "stream")
@@ -540,6 +567,29 @@ def parse_args():
     p.add_argument("--serve_beam", type=int, default=1,
                    help="--stage serving: beam width per request (1 = "
                         "greedy)")
+    p.add_argument("--serve_stream", type=int, default=0,
+                   help="--stage serving: 1 = submit every probe request "
+                        "as streaming traffic — asserts prefix "
+                        "consistency end to end and adds TTFT / "
+                        "inter-chunk-gap percentiles to the JSON line")
+    p.add_argument("--serve_cache", type=int, default=0,
+                   help="--stage serving: exact-result cache capacity "
+                        "(entries; 0 = off).  The probe keeps a hit-vs-"
+                        "miss-twin drill record scripts/serve_report.py "
+                        "gates on")
+    p.add_argument("--serve_zipf", type=float, default=0.0,
+                   help="--stage serving: zipf exponent for the request "
+                        "mix over --serve_unique distinct videos (0 = "
+                        "round-robin; real traffic is ~1.0-1.2)")
+    p.add_argument("--serve_unique", type=int, default=None,
+                   help="--stage serving: distinct videos in the request "
+                        "mix (default: one per request — no repeats, the "
+                        "historical probe)")
+    p.add_argument("--serve_cache_compare", type=int, default=0,
+                   help="--stage serving: 1 = also run the cache-OFF twin "
+                        "at the same seed in the same bench run and "
+                        "report cache_off_captions_per_sec / "
+                        "cache_speedup (requires --serve_cache > 0)")
     p.add_argument("--probe_eos_bias", type=float, default=10.0,
                    help="EOS-logit bias for the rollout step-count probe "
                         "(simulates a converged policy's early "
@@ -622,6 +672,16 @@ def resolved_config(args) -> dict:
         config["serve_rate"] = args.serve_rate
         config["serve_buckets"] = args.serve_buckets
         config["serve_beam"] = args.serve_beam
+        # Latency-floor axes (streamed emission, the result cache, and
+        # the request mix all change what a latency number means).
+        config["serve_stream"] = args.serve_stream
+        config["serve_cache"] = args.serve_cache
+        config["serve_zipf"] = args.serve_zipf
+        config["serve_unique"] = args.serve_unique
+        # compare mode changes the measurement protocol (unmeasured
+        # rehearsal before the measured probe), so records from the two
+        # modes are not comparable and must not share a cache entry.
+        config["serve_cache_compare"] = args.serve_cache_compare
     return config
 
 

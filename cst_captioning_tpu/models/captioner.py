@@ -55,13 +55,16 @@ class CaptionModel(nn.Module):
                                     # must cover the label seq_length
     dtype: jnp.dtype = jnp.float32
     use_pallas_attention: bool = False  # fused VMEM attention kernel (lstm)
-    decode_kernel: str = "reference"    # "reference" | "pallas": decode-step
-                                        # cell for samplers/beam/eval — the
+    decode_kernel: str = "reference"    # "reference" | "pallas" | "bf16":
+                                        # decode-step cell for samplers/
+                                        # beam/eval — the flax cell, the
                                         # fused Pallas attention+LSTM kernel
-                                        # (ops/pallas_decode_cell.py) vs the
-                                        # flax cell.  Decode/rollout only;
-                                        # teacher forcing is unaffected.
-                                        # Swept by the autotuner (tuning/)
+                                        # (ops/pallas_decode_cell.py), or
+                                        # the bfloat16 low-precision variant
+                                        # (ops/bf16_decode.py, parity-gated).
+                                        # Decode/rollout only; teacher
+                                        # forcing is unaffected.  Swept by
+                                        # the autotuner (tuning/)
     fusion_type: str = "temporal"   # "temporal" | "modality" (manet variant)
     scan_unroll: int = 1            # lax.scan unroll for decoder/sampling
                                     # scans (see decoder_lstm.scan_decoder)

@@ -1,0 +1,146 @@
+"""Low-precision (bfloat16) decode-step variant behind ``--decode_kernel``.
+
+The rollout/eval decode step is latency-bound at serving shapes: small
+per-step matmuls whose cost on MXU-bearing hardware is dominated by
+operand traffic, which bfloat16 halves.  ``--decode_kernel bf16`` keeps
+the model's stored parameters fp32 and swaps ONLY the decode-step
+compute to bfloat16 — the same flax machinery ``--use_bfloat16`` uses
+for training, scoped to ``make_decode_step`` so teacher forcing, the RL
+gradient, and every checkpoint stay untouched.
+
+Boundary contract (what keeps the variant drop-in):
+
+- **fp32 at the seams.**  The step receives the fp32 carry the callers
+  allocate (samplers, beam, the serving engine's slot buffers), casts it
+  to bf16 for the cell, and casts the result back.  The round trip is
+  numerically free: bf16 -> fp32 is exact, and fp32 -> bf16 of an
+  exactly-representable value is the identity — so the fp32-carry
+  formulation computes the SAME sequence a bf16-carry one would.
+- **fp32 logits.**  Scores/argmax/log-softmax downstream (beam's score
+  buffers are fp32 by design) see fp32 logits; only the cell math is
+  low-precision.
+
+Parity gate (the honesty rule): bf16 decode is NOT bit-identical to
+fp32 — captions may differ where fp32 logit margins are below bf16
+resolution.  It therefore ships gated, never silently: the declared
+bound is :data:`DEFAULT_CIDER_DELTA_BOUND` on the corpus CIDEr delta
+vs the fp32 decode of the same checkpoint (``scripts/bf16_parity.py``
+measures it — the cpu512_healthy protocol is the record of evidence),
+:func:`parity_gate` is the one decision rule, and its failure mode is
+pinned: fall back to ``reference``, the bit-exact path.  Whether the
+variant actually pays is a platform question — it rides the tuner's
+``decode_kernel`` axis (tuning/sweep.py) so ``TUNED_CONFIGS.json``
+records a measured per-platform winner with provenance.
+
+Unsupported configurations (a model already computing in bfloat16 has
+nothing to gain and would double-cast) fall back to the reference cell
+with one log line — the ``pallas_decode_cell`` fallback discipline.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("cst_captioning_tpu.ops.bf16_decode")
+
+#: Declared CIDEr-delta bound for the parity gate: |CIDEr(bf16) -
+#: CIDEr(fp32)| on the same checkpoint + split must stay within this, or
+#: the recommendation is the bit-exact ``reference`` fallback.  0.02
+#: CIDEr is well inside the run-to-run spread of the training protocol
+#: itself (cpu512_healthy stage deltas are ~0.2-0.8), so a pass means
+#: the precision change is lost in training noise.
+DEFAULT_CIDER_DELTA_BOUND = 0.02
+
+_warned_fallback = set()
+
+
+def bf16_decode_supported(model) -> Tuple[bool, str]:
+    """(eligible, reason): the bf16 variant wraps the reference flax cell,
+    so every decoder configuration the reference step serves is eligible —
+    EXCEPT a model whose compute dtype is already bfloat16 (the variant
+    would be an identity wrapper paying two extra casts per step)."""
+    if jnp.dtype(getattr(model, "dtype", jnp.float32)) == \
+            jnp.dtype(jnp.bfloat16):
+        return False, "model compute dtype is already bfloat16"
+    return True, ""
+
+
+def warn_fallback_once(reason: str) -> None:
+    """--decode_kernel bf16 on an ineligible model: log ONCE per reason
+    per process and continue on the reference cell (the pallas-fallback
+    discipline — a tuned record from another config degrades, not
+    crashes)."""
+    if reason not in _warned_fallback:
+        _warned_fallback.add(reason)
+        log.warning("decode_kernel=bf16 unsupported here (%s); "
+                    "falling back to the reference decode cell", reason)
+
+
+def make_bf16_decode_step(model, variables, memory: jnp.ndarray,
+                          proj_mem: jnp.ndarray,
+                          pooled: jnp.ndarray) -> Callable:
+    """Build ``step(carry, token (N,)) -> (carry, logits (N, V))`` with
+    bfloat16 cell compute — the same contract as
+    ``ops.sampling.make_decode_step``.
+
+    The cloned module (``dtype=bfloat16``, ``decode_kernel="reference"``
+    so the clone can never re-enter kernel routing) shares the caller's
+    fp32 parameter tree; flax casts per-op to the module dtype, exactly
+    as ``--use_bfloat16`` does in training.  Encodings are cast once at
+    closure build (not per step); carry and logits are fp32 at the
+    boundary (module doc).
+    """
+    m = model.clone(dtype=jnp.bfloat16, decode_kernel="reference")
+    bf16 = jnp.bfloat16
+    mem_b = memory.astype(bf16)
+    proj_b = proj_mem.astype(bf16)
+    pooled_b = pooled.astype(bf16)
+
+    def cast(tree, dtype):
+        # Float leaves only: the transformer carry holds int32 (token
+        # buffer, position) leaves that must keep their dtype — casting
+        # them would crash its dynamic_update_slice (and mean nothing).
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def to_bf16(tree):
+        return cast(tree, bf16)
+
+    def to_f32(tree):
+        return cast(tree, jnp.float32)
+
+    def step(carry, token):
+        carry, logits = m.apply(
+            variables, to_bf16(carry), token[:, None], mem_b, proj_b,
+            pooled_b, method="decode",
+        )
+        return to_f32(carry), logits[:, 0, :].astype(jnp.float32)
+
+    return step
+
+
+def parity_gate(cider_fp32: float, cider_bf16: float,
+                bound: float = DEFAULT_CIDER_DELTA_BOUND) -> dict:
+    """The ONE decision rule for shipping the bf16 decode variant.
+
+    -> {"delta", "bound", "within_bound", "kernel_recommendation"}:
+    within the declared bound the low-precision variant is eligible (the
+    tuner then decides whether it *pays*); outside it the recommendation
+    is pinned to ``reference`` — the bit-exact path is always the
+    fallback, never a worse-quality caption shipped silently.
+    """
+    delta = float(cider_bf16) - float(cider_fp32)
+    within = abs(delta) <= float(bound)
+    return {
+        "cider_fp32": float(cider_fp32),
+        "cider_bf16": float(cider_bf16),
+        "delta": delta,
+        "bound": float(bound),
+        "within_bound": within,
+        "kernel_recommendation": "bf16" if within else "reference",
+    }

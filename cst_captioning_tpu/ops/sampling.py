@@ -81,8 +81,15 @@ def make_decode_step(
     fp32-ULP-close to this reference cell (test-pinned).  Unsupported
     configurations (multi-layer, pooled, transformer) fall back here with
     a one-time log line.
+
+    ``model.decode_kernel == "bf16"`` routes through the low-precision
+    decode variant (ops/bf16_decode.py): bfloat16 cell compute, fp32
+    carry/logits at the boundary — NOT bit-identical to fp32, so it
+    ships behind the CIDEr-delta parity gate with this reference cell
+    pinned as the fallback (same one-time-log fallback discipline).
     """
-    if getattr(model, "decode_kernel", "reference") == "pallas":
+    kernel = getattr(model, "decode_kernel", "reference")
+    if kernel == "pallas":
         from .pallas_decode_cell import (
             make_pallas_decode_step,
             pallas_decode_supported,
@@ -93,6 +100,18 @@ def make_decode_step(
         if ok:
             return make_pallas_decode_step(model, variables, memory,
                                            proj_mem)
+        warn_fallback_once(reason)
+    elif kernel == "bf16":
+        from .bf16_decode import (
+            bf16_decode_supported,
+            make_bf16_decode_step,
+            warn_fallback_once,
+        )
+
+        ok, reason = bf16_decode_supported(model)
+        if ok:
+            return make_bf16_decode_step(model, variables, memory,
+                                         proj_mem, pooled)
         warn_fallback_once(reason)
 
     def step(carry, token):

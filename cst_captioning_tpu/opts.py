@@ -299,15 +299,21 @@ def _add_decode_args(p: argparse.ArgumentParser) -> None:
                         "bit-identical to the full-length scan at any "
                         "value; 0 = legacy single full-length scan")
     g.add_argument("--decode_kernel", default=DEFAULT_DECODE_KERNEL,
-                   choices=("reference", "pallas"),
+                   choices=("reference", "pallas", "bf16"),
                    help="decode-step cell for samplers/beam/eval decode: "
                         "'reference' = the flax cell; 'pallas' = the fused "
                         "VMEM attention+LSTM decode kernel "
                         "(ops/pallas_decode_cell.py; single-layer "
                         "attention-LSTM only, other configs fall back with "
-                        "a log line).  Swept by the autotuner; the "
-                        "platform's tuning record may set it as the "
-                        "default (PARITY.md 'Tuned configs')")
+                        "a log line); 'bf16' = the low-precision decode "
+                        "variant (ops/bf16_decode.py: the same cell with "
+                        "bfloat16 compute, fp32 carry/logits at the "
+                        "boundary — parity-gated by scripts/bf16_parity.py "
+                        "against the declared CIDEr delta bound, with "
+                        "'reference' pinned as the bit-exact fallback).  "
+                        "Swept by the autotuner; the platform's tuning "
+                        "record may set it as the default (PARITY.md "
+                        "'Tuned configs')")
 
 
 def _validated_buckets(text: str) -> str:
@@ -421,6 +427,21 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "bumps serve_slow_chunks — the step-progress "
                         "wedge signal below the hard --wedge_timeout "
                         "kill.  0 disables")
+    g.add_argument("--serve_cache",
+                   type=_nonneg_int(
+                       "--serve_cache (or CST_SERVE_CACHE)",
+                       "result cache disabled"),
+                   default=os.environ.get("CST_SERVE_CACHE") or 256,
+                   help="exact-result cache capacity (entries): repeated "
+                        "requests for the same video (zipfian traffic) "
+                        "replay the cached caption instead of paying the "
+                        "encoder + decode again — bit-identical by "
+                        "construction, keyed by feature hash + the bench "
+                        "cache-config identity + a params fingerprint so "
+                        "a tuned-config, kernel, beam, or checkpoint "
+                        "change invalidates correctly (SERVING.md "
+                        "'Streaming & result cache').  Bounded LRU; 0 = "
+                        "disabled.  Env fallback: CST_SERVE_CACHE")
     g.add_argument("--serve_heartbeat_file", default=None,
                    help="scripts/serve.py: write a liveness "
                         "heartbeat.json here (watchdog discipline: "
@@ -692,6 +713,28 @@ def warn_serving_decode_chunk(ns: argparse.Namespace) -> None:
               "slots only free every --max_length steps; pass a chunked "
               "--decode_chunk (e.g. 8) for continuous batching",
               file=sys.stderr)
+
+
+_warned_stream_legacy = False
+
+
+def warn_stream_legacy_scan() -> None:
+    """``{"op": "stream"}`` traffic on an engine configured with
+    ``--decode_chunk 0``: the legacy full-length scan has no mid-caption
+    chunk boundary, so every token is harvested at once and "streaming"
+    degenerates to ONE terminal chunk after the whole decode.  Called by
+    the serving front end on the first stream request it sees in that
+    configuration — one stderr line naming the fix (the --decode_chunk-0
+    serving warn-once pattern), not silence and not a per-request nag."""
+    global _warned_stream_legacy
+    if _warned_stream_legacy:
+        return
+    _warned_stream_legacy = True
+    print("warning: {\"op\": \"stream\"} with --decode_chunk 0 (legacy "
+          "full-length scan) emits everything at once — streaming "
+          "degenerates to one terminal chunk; pass a chunked "
+          "--decode_chunk (e.g. 8) to stream tokens per chunk",
+          file=sys.stderr)
 
 
 _warned_serve_deadline = False

@@ -84,6 +84,7 @@ KINDS: Dict[str, str] = {
     "serve_wedge": "req",
     "serve_garble": "req",
     "admit_err": "req",
+    "serve_cache": "req",
 }
 
 _SPEC_RE = re.compile(

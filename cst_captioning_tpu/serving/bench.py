@@ -11,10 +11,26 @@ for every bucket's programs up front, and any program build after that
 raises — steady-state serving must read 0 recompiles (the acceptance
 contract; ``buckets.ProgramCache`` is the counter).
 
-Determinism: the arrival schedule and per-request features are seeded,
-so two runs issue the identical request stream; the measured latencies
-are wall-clock (that is the point).  The repo bench (`bench.py --stage
-serving`) feeds this into its one-JSON-line + cache machinery.
+Latency-floor extensions (SERVING.md "Streaming & result cache"):
+
+- ``zipf_alpha``/``unique_videos`` shape the request mix: real traffic
+  is zipfian, so the stream draws each request's video from a seeded
+  rank-``1/r^alpha`` distribution over ``unique_videos`` distinct
+  feature sets (0 = the historical one-unique-video-per-request mix).
+- ``cache_size`` arms the exact-result cache (serving/cache.py) and the
+  probe keeps a DRILL RECORD: every cache-hit caption is compared bit
+  for bit against its miss twin (the first decoded completion of the
+  same video) — ``scripts/serve_report.py`` exits 1 on any mismatch.
+- ``stream`` submits every request as streaming traffic, asserts PREFIX
+  CONSISTENCY (the concatenation of a request's chunks must equal its
+  final caption — a violation raises, failing the bench), and reports
+  time-to-first-token and inter-chunk-gap percentiles beside p50/p99.
+
+Determinism: the arrival schedule, per-video features, and the zipfian
+mix are seeded, so two runs issue the identical request stream; the
+measured latencies are wall-clock (that is the point).  The repo bench
+(`bench.py --stage serving`) feeds this into its one-JSON-line + cache
+machinery.
 """
 
 from __future__ import annotations
@@ -25,7 +41,8 @@ from typing import Any, Dict, Optional, Sequence
 import numpy as np
 
 from .buckets import DEFAULT_BUCKETS
-from .engine import ServingEngine
+from .cache import ResultCache
+from .engine import ServingEngine, _trim_eos
 
 
 def poisson_arrivals(num_requests: int, rate_hz: float,
@@ -36,50 +53,87 @@ def poisson_arrivals(num_requests: int, rate_hz: float,
                                      size=int(num_requests)))
 
 
+def zipfian_mix(num_requests: int, unique_videos: int, alpha: float,
+                seed: int = 0) -> np.ndarray:
+    """Video index per request: rank-``1/r^alpha`` draws over the unique
+    set (``alpha`` <= 0 = deterministic round-robin, the historical
+    every-request-distinct mix when ``unique_videos == num_requests``)."""
+    n, u = int(num_requests), max(1, int(unique_videos))
+    if alpha <= 0:
+        return np.arange(n) % u
+    ranks = np.arange(1, u + 1, dtype=np.float64)
+    p = ranks ** -float(alpha)
+    p /= p.sum()
+    return np.random.default_rng(seed).choice(u, size=n, p=p)
+
+
 def serving_probe(model, variables, feat_shapes: Sequence,
                   *, num_requests: int = 24, rate_hz: float = 8.0,
                   max_len: int = 30, beam_size: int = 1,
                   length_norm: float = 0.0, decode_chunk: int = 8,
                   bucket_sizes: Sequence[int] = DEFAULT_BUCKETS,
                   queue_limit: int = 0, seed: int = 0,
+                  stream: bool = False, cache_size: int = 0,
+                  unique_videos: Optional[int] = None,
+                  zipf_alpha: float = 0.0,
                   registry=None, tracer=None,
                   clock=time.perf_counter) -> Dict[str, Any]:
     """Drive one engine through a seeded Poisson load; -> metrics dict.
 
-    Raises ``RuntimeError`` if any program compiles after warmup — the
-    0-recompiles-under-steady-load assert, in the probe itself so a
-    regression fails the bench rather than shipping a latency cliff.
+    Raises ``RuntimeError`` if any program compiles after warmup (the
+    0-recompiles-under-steady-load assert) or, under ``stream``, if any
+    request's concatenated chunks differ from its final caption — both
+    in the probe itself so a regression fails the bench rather than
+    shipping a latency cliff or a lying stream.
     """
     n = int(num_requests)
+    uniq = n if unique_videos is None else max(1, min(int(unique_videos), n))
     arrivals = poisson_arrivals(n, rate_hz, seed)
     feat_rng = np.random.default_rng(seed + 1)
     feats = [
         [feat_rng.standard_normal(s).astype(np.float32)
          for s in feat_shapes]
-        for _ in range(n)
+        for _ in range(uniq)
     ]
+    video_of = zipfian_mix(n, uniq, zipf_alpha, seed + 2)
+    cache = ResultCache(int(cache_size)) if cache_size else None
     engine = ServingEngine(
         model, variables, feat_shapes, max_len=max_len,
         beam_size=beam_size, length_norm=length_norm,
         decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
-        queue_limit=queue_limit, registry=registry, tracer=tracer,
-        clock=clock)
+        queue_limit=queue_limit, result_cache=cache,
+        registry=registry, tracer=tracer, clock=clock)
     warm_builds = engine.warm()["compiles"]
 
     t0 = clock()
     submitted = 0
     latencies: Dict[Any, float] = {}
+    tokens: Dict[Any, np.ndarray] = {}
+    hit: Dict[Any, bool] = {}
+    chunks: Dict[Any, list] = {}
     shed = 0
-    while len(latencies) + shed < n:
-        now = clock() - t0
-        while submitted < n and arrivals[submitted] <= now:
-            if not engine.submit(submitted, feats[submitted]):
-                shed += 1
-            submitted += 1
-        for comp in engine.step():
+
+    def harvest(comps):
+        nonlocal shed
+        for comp in comps:
             # Latency from the SCHEDULED arrival (open-loop convention).
             latencies[comp.request_id] = (
                 (comp.done_at - t0) - arrivals[comp.request_id])
+            tokens[comp.request_id] = np.asarray(comp.tokens)
+            hit[comp.request_id] = bool(comp.cache_hit)
+        if stream:
+            for ch in engine.pop_stream_chunks():
+                chunks.setdefault(ch.request_id, []).append(ch)
+
+    while len(latencies) + shed < n:
+        now = clock() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            if not engine.submit(submitted,
+                                 feats[int(video_of[submitted])],
+                                 stream=stream):
+                shed += 1
+            submitted += 1
+        harvest(engine.step())
         if engine.idle and submitted < n:
             time.sleep(min(max(arrivals[submitted] - (clock() - t0), 0.0),
                            0.01))
@@ -92,6 +146,60 @@ def serving_probe(model, variables, feat_shapes: Sequence,
             f"serving recompiled under steady load: {recompiles} program "
             f"build(s) after warmup (bucket discipline violated — "
             "SERVING.md 'Bucket policy')")
+
+    stream_out: Dict[str, Any] = {"enabled": bool(stream)}
+    if stream:
+        # Prefix consistency, end to end: every request's streamed chunks
+        # must concatenate to its final caption, bit for bit.
+        bad = []
+        for rid, row in tokens.items():
+            got = (np.concatenate([np.asarray(c.tokens) for c in
+                                   sorted(chunks.get(rid, []),
+                                          key=lambda c: c.seq)])
+                   if chunks.get(rid) else np.zeros((0,), np.int32))
+            if not np.array_equal(got, _trim_eos(row)):
+                bad.append(rid)
+        if bad:
+            raise RuntimeError(
+                f"streamed chunks are not prefix-consistent with the "
+                f"final caption for request(s) {bad[:5]} — the streaming "
+                "contract is broken (SERVING.md)")
+        stream_out.update({
+            "chunks": stats["stream_chunks"],
+            "ttft_p50_ms": stats["ttft_p50_ms"],
+            "ttft_p99_ms": stats["ttft_p99_ms"],
+            "chunk_gap_p50_ms": stats["chunk_gap_p50_ms"],
+            "chunk_gap_p99_ms": stats["chunk_gap_p99_ms"],
+            "prefix_ok": True,
+        })
+
+    cache_out: Dict[str, Any] = {"enabled": bool(cache_size)}
+    if cache_size:
+        # The drill record: every hit must be bit-identical to its miss
+        # twin (the first DECODED completion of the same video at this
+        # configuration).  serve_report exits 1 on a mismatch.
+        twin: Dict[int, np.ndarray] = {}
+        for rid in sorted(tokens):
+            if not hit[rid]:
+                twin.setdefault(int(video_of[rid]), tokens[rid])
+        mismatches = sum(
+            1 for rid in tokens
+            if hit[rid] and not np.array_equal(
+                tokens[rid], twin.get(int(video_of[rid]))))
+        hm = stats["cache_hits"] + stats["cache_misses"]
+        cache_out.update({
+            "hits": stats["cache_hits"],
+            "misses": stats["cache_misses"],
+            "evictions": stats["cache_evictions"],
+            "bypass": stats["cache_bypass"],
+            "errors": stats["cache_errors"],
+            "entries": stats["cache_entries"],
+            "capacity": stats["cache_capacity"],
+            "hit_rate": round(stats["cache_hits"] / hm, 4) if hm else None,
+            "parity_ok": mismatches == 0,
+            "parity_mismatches": mismatches,
+        })
+
     lat_ms = np.asarray(sorted(latencies.values())) * 1e3
     pct = (lambda q: round(float(np.percentile(lat_ms, q)), 3)
            if lat_ms.size else None)
@@ -106,14 +214,19 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "shed": shed,
         "rate_hz": float(rate_hz),
         "arrival_seed": int(seed),
+        "unique_videos": uniq,
+        "zipf_alpha": float(zipf_alpha),
         "makespan_s": round(makespan, 3),
         "recompiles_after_warmup": recompiles,
         "program_builds_warm": warm_builds,
         "buckets": list(engine.buckets),
         "slots": stats["slots"],
+        "chunk_dispatches": stats["chunk_dispatches"],
         "beam_size": engine.beam_size,
         "decode_chunk": engine.chunk,
         "max_len": int(max_len),
+        "stream": stream_out,
+        "cache": cache_out,
         # Fault-tolerance audit (all 0 on a healthy fault-free probe;
         # scripts/serve_report.py renders them and FAILS on a
         # rebuild-recompile violation — RESILIENCE.md "Serving faults").

@@ -1,0 +1,130 @@
+"""Exact-result cache: one decode per distinct video per configuration.
+
+Real serving traffic is zipfian — the same viral video arrives millions
+of times — and an autoregressive decode is deterministic, so the second
+identical request should cost a dictionary lookup, not an encoder pass
+plus ``max_len`` decode steps.  This is the compiler-first O(1)
+autoregressive-caching discipline (PAPERS.md arXiv 2603.09555) applied
+one level up: where ``buckets.ProgramCache`` caches *programs* by
+configuration identity, this module caches *results* by
+
+    (configuration identity, parameter fingerprint, feature fingerprint)
+
+The identity tuple is built by the engine from the SAME axes as the bench
+cache-config identity (``buckets.config_key``: beam, max_len,
+decode_chunk, length_norm, decode_kernel, scan_unroll, feature geometry,
+dtype), so a tuned-config, kernel, or beam change can never replay a
+stale caption — two configurations that could decode differently never
+share an entry.  The parameter fingerprint (hashed once at engine
+startup) extends that rule to the weights: two engines serving different
+checkpoints never share entries either.
+
+Bounded LRU: ``capacity`` entries, least-recently-HIT evicted first.
+Hit/miss/evict/bypass counters live with the engine (declared at 0 in
+``engine.COUNTERS``); the cache itself is policy-free storage.
+
+Threading: entries live under a named lock (``serving.result_cache``)
+so a cache instance may be shared across engines; the lock is a LEAF —
+no other project lock is ever acquired while holding it, and callers
+keep their registry bumps outside it, so it needs no LOCK_ORDER row.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.locksan import named_lock
+
+
+def feature_fingerprint(feats: Sequence[np.ndarray]) -> str:
+    """Content hash of one request's per-modality features.
+
+    SHA-256 over each array's shape, dtype, and raw bytes — exact, not
+    approximate: the cache contract is BIT-identical replay, so only
+    bit-identical inputs may share a key.  Host-side numpy only (the
+    arrays are the request's pre-``device_put`` host features).
+    """
+    h = hashlib.sha256()
+    for f in feats:
+        a = np.ascontiguousarray(np.asarray(f, np.float32))
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def params_fingerprint(variables) -> str:
+    """Content hash of the model variables (params tree).
+
+    Paid ONCE at engine startup when a result cache is attached — ~100ms
+    for the shipped model — so a shared cache can never serve checkpoint
+    A's caption to checkpoint B's engine.  Leaves are hashed in
+    deterministic tree order (jax tree flatten order is stable for a
+    given structure).
+    """
+    import jax
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves(variables)
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """Bounded LRU of finished caption token rows.
+
+    ``get`` returns a COPY (callers hand tokens to response paths that
+    may hold them indefinitely); ``put`` returns how many entries were
+    evicted to make room, so the engine can count evictions into its
+    declared-at-0 counter.  ``capacity`` <= 0 builds a cache that never
+    stores (every lookup misses) — prefer passing ``None`` to the engine
+    instead to skip the lookup entirely (counted as bypass there).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._lock = named_lock("serving.result_cache")
+        self._entries: "OrderedDict[Tuple, np.ndarray]" = \
+            OrderedDict()  # cstlint: guarded_by=self._lock
+
+    def get(self, key: Tuple) -> Optional[np.ndarray]:
+        with self._lock:
+            row = self._entries.get(key)
+            if row is None:
+                return None
+            self._entries.move_to_end(key)
+            return row.copy()
+
+    def put(self, key: Tuple, tokens: np.ndarray) -> int:
+        if self.capacity <= 0:
+            return 0
+        row = np.asarray(tokens).copy()
+        evicted = 0
+        with self._lock:
+            self._entries[key] = row
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+        return evicted
+
+    def invalidate(self, key: Tuple) -> bool:
+        """Drop one entry (a detected-bad hit must not be replayed)."""
+        with self._lock:
+            return self._entries.pop(key, None) is not None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self.capacity}

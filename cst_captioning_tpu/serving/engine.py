@@ -55,6 +55,31 @@ Fault tolerance (RESILIENCE.md "Serving faults"):
 - **Admission errors** (injected as ``admit_err``) re-queue the request
   at the head and retry next step, bounded per request.
 
+Latency floor (SERVING.md "Streaming & result cache"):
+
+- **Streaming** (``Request.stream``): a greedy resident's NEW caption
+  tokens are emitted as a :class:`StreamChunk` after every scheduler
+  chunk — no new device programs, the chunks are sliced from the same
+  one-batched-harvest the scheduler already fetches — so a client sees
+  its first words after one chunk instead of after the whole caption.
+  The concatenation of a request's stream chunks is BIT-IDENTICAL to its
+  final caption (prefix consistency; an engine rebuild's replayed steps
+  re-emit nothing).  Beam search cannot stream honestly — the best
+  hypothesis is unknown until the backtrack — so a streamed beam request
+  emits ONE terminal chunk at harvest.  Time-to-first-token and
+  inter-chunk gaps feed ``serve_ttft_ms`` / ``serve_chunk_gap_ms``.
+- **Exact-result cache** (``result_cache=``, serving/cache.py): submits
+  are looked up by (config identity, params fingerprint, feature
+  fingerprint) BEFORE admission — a hit completes instantly with the
+  cached caption, paying zero encoder/decode program invocations
+  (``chunk_dispatches`` and ``serve_admitted`` provably unmoved); a miss
+  decodes normally and writes back at harvest.  The identity reuses the
+  bench cache-config axes, so a tuned-config, kernel, or beam change
+  invalidates correctly.  A cache failure (injected as
+  ``serve_cache@req=N``) is absorbed: counted, health-degraded, and the
+  request decodes fresh — the cache may only ever make a request
+  cheaper, never wronger.
+
 Threading: the engine is single-owner — ``submit``/``step``/``drain``
 must be called from one thread (the server's scheduler loop); front-end
 reader threads hand lines to that loop, never to the engine directly.
@@ -85,6 +110,7 @@ from ..resilience.garble import GarbledChunk, garbled_decode_slots, \
     health_status
 from ..telemetry.spans import trace_span
 from .buckets import DEFAULT_BUCKETS, ProgramCache, config_key, pick_bucket
+from .cache import ResultCache, feature_fingerprint, params_fingerprint
 
 log = logging.getLogger("cst_captioning_tpu.serving.engine")
 
@@ -97,7 +123,11 @@ COUNTERS = ("serve_requests", "serve_admitted", "serve_completed",
             "serve_rebuilds", "serve_rebuild_recompiles",
             "serve_garble_detected", "serve_wedge_detected",
             "serve_admit_errors", "serve_replay_divergence",
-            "serve_slow_chunks")
+            "serve_slow_chunks",
+            # Latency floor (SERVING.md "Streaming & result cache").
+            "serve_stream_chunks", "serve_cache_hits", "serve_cache_misses",
+            "serve_cache_evictions", "serve_cache_bypass",
+            "serve_cache_errors")
 
 
 class ServingUnrecoverable(RuntimeError):
@@ -120,6 +150,11 @@ class Request:
     #: Absolute engine-clock deadline; None = no TTL.
     deadline: Optional[float] = None
     admit_attempts: int = 0
+    #: Emit per-chunk StreamChunk records ({"op": "stream"} traffic).
+    stream: bool = False
+    #: Result-cache write-back key (None = bypassed / cache disabled /
+    #: lookup faulted); set at submit, consumed at harvest.
+    cache_key: Optional[tuple] = None
 
 
 @dataclass
@@ -133,6 +168,30 @@ class Completion:
     done_at: float
     latency_s: float
     decode_steps: int
+    meta: Optional[dict] = None
+    #: Streaming bookkeeping (0 / None on non-streamed requests): chunks
+    #: emitted before this completion, and time-to-first-token seconds.
+    stream_chunks: int = 0
+    ttft_s: Optional[float] = None
+    #: True when the caption came from the exact-result cache (zero
+    #: encoder/decode invocations paid).
+    cache_hit: bool = False
+
+
+@dataclass
+class StreamChunk:
+    """One incremental slice of a streamed caption.
+
+    ``tokens`` are the NEW caption tokens this chunk produced (EOS/pad
+    trimmed; possibly the whole caption for beam/cache-hit terminals).
+    Prefix consistency: concatenating a request's chunks in ``seq`` order
+    reproduces the final caption's tokens bit for bit — pinned by
+    tests/test_serving_stream.py and end-to-end by the serving bench.
+    """
+
+    request_id: Any
+    seq: int
+    tokens: np.ndarray
     meta: Optional[dict] = None
 
 
@@ -163,6 +222,14 @@ class _Resident:
     #: Tokens emitted before an engine rebuild — the persisted prefix the
     #: deterministic replay is verified against at harvest.
     prefix: Optional[np.ndarray] = None
+    #: Streaming state: caption tokens already emitted as chunks (a
+    #: rebuild's replayed steps re-derive but never re-emit them), chunk
+    #: ordinal, and emission clocks for the TTFT / inter-chunk-gap
+    #: metrics.
+    streamed: int = 0
+    chunks_emitted: int = 0
+    first_emit: Optional[float] = None
+    last_emit: Optional[float] = None
 
 
 class ServingEngine:
@@ -185,6 +252,11 @@ class ServingEngine:
     ``step_budget_ms`` flags slow chunks (0 = off) into the health plane;
     ``degraded_window_s`` is how long after a recovery event ``health()``
     reports ``degraded``.
+
+    ``result_cache`` (serving/cache.py, shareable across engines) arms
+    the exact-result cache in front of admission: a hit completes without
+    touching the encoder or decode programs.  None = every request
+    decodes (the historical behavior; nothing is counted as bypass).
     """
 
     def __init__(self, model, variables, feat_shapes: Sequence[Tuple[int, int]],
@@ -199,6 +271,7 @@ class ServingEngine:
                  rebuild_limit: int = 2,
                  step_budget_ms: float = 0.0,
                  degraded_window_s: float = 60.0,
+                 result_cache: Optional[ResultCache] = None,
                  registry=None, tracer=None,
                  clock: Callable[[], float] = time.monotonic):
         if getattr(model, "decoder_type", "lstm") != "lstm":
@@ -245,6 +318,34 @@ class ServingEngine:
         self._latencies: deque = deque(maxlen=1024)
         self._chunk_wall: deque = deque(maxlen=128)
         self._dropped: List[Dropped] = []
+        # Latency-floor state (all scheduler-owned, like the queue).
+        self._stream_chunks: List[StreamChunk] = []  # cstlint: owned_by=scheduler
+        self._hits: List[Completion] = []  # cstlint: owned_by=scheduler
+        self._ttft: deque = deque(maxlen=1024)
+        self._gaps: deque = deque(maxlen=4096)
+        self._stream_emitted = 0
+        self._chunk_dispatches = 0
+        self._result_cache = result_cache
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._cache_evictions = 0
+        self._cache_bypass = 0
+        self._cache_errors = 0
+        if result_cache is not None:
+            # Paid once: a shared cache must never replay captions across
+            # different weights or decode configurations (cache.py).
+            # Built from config_key directly, NOT _config_key: the recover
+            # mode's "-recover" program suffix compiles the same math, so
+            # recover-on and recover-off engines share result entries.
+            self._params_fp = params_fingerprint(variables)
+            self._result_identity = config_key(
+                kind="result", bucket=0, beam_size=self.beam_size,
+                max_len=self.max_len, decode_chunk=self.chunk,
+                length_norm=self.length_norm,
+                decode_kernel=getattr(model, "decode_kernel", "reference"),
+                scan_unroll=getattr(model, "scan_unroll", 1),
+                feat_shapes=self._feat_shapes,
+                dtype=str(getattr(model, "dtype", jnp.float32)))
         self._submitted = 0
         self._completed = 0
         self._shed = 0
@@ -482,12 +583,17 @@ class ServingEngine:
 
     def submit(self, request_id, feats: Sequence[np.ndarray],
                meta: Optional[dict] = None,
-               deadline_ms: Optional[float] = None) -> bool:
+               deadline_ms: Optional[float] = None,
+               stream: bool = False,
+               no_cache: bool = False) -> bool:
         """Queue one request.  Returns False (sheds) when the bounded
         queue is full — the engine's backpressure signal; the front end
         turns it into an explicit reject response.  ``deadline_ms``
         overrides the engine's default TTL for this request (None = use
-        the default; 0 = explicitly no deadline)."""
+        the default; 0 = explicitly no deadline).  ``stream`` emits
+        per-chunk :class:`StreamChunk` records (``pop_stream_chunks``);
+        ``no_cache`` skips the exact-result cache for this request
+        (counted as ``serve_cache_bypass`` — the drill's miss twin)."""
         self._submitted += 1
         index = self._submitted - 1        # submission ordinal (@req=N)
         self._inc("serve_requests")
@@ -497,22 +603,98 @@ class ServingEngine:
             raise ValueError(
                 f"request {request_id!r} feature shapes {shapes} do not "
                 f"match the engine's compiled geometry {self._feat_shapes}")
+        arrival = self.clock()
+        # Exact-result cache, IN FRONT of admission (and of the bounded
+        # queue: a hit consumes no slot, no queue depth, no decode — it
+        # would be self-defeating to shed one).
+        cache_key = None
+        if self._result_cache is not None:
+            if no_cache:
+                self._cache_bypass += 1
+                self._inc("serve_cache_bypass")
+            else:
+                row = None
+                try:
+                    if self._plan is not None and \
+                            self._plan.fire("serve_cache", index):
+                        raise InjectedFault(
+                            f"injected serve_cache at request {index}")
+                    cache_key = (self._result_identity, self._params_fp,
+                                 feature_fingerprint(feats))
+                    row = self._result_cache.get(cache_key)
+                except Exception as e:
+                    # A broken cache may cost a decode, never a request:
+                    # fall through to the miss path (no write-back — the
+                    # cache is suspect) and surface the event in health.
+                    cache_key = None
+                    self._cache_errors += 1
+                    self._inc("serve_cache_errors")
+                    self._note_recovery_event()
+                    log.warning("result-cache lookup failed for request "
+                                "%r (%s); decoding fresh", request_id, e)
+                if row is not None:
+                    self._cache_hits += 1
+                    self._inc("serve_cache_hits")
+                    self._complete_hit(request_id, row, arrival,
+                                       stream=stream, meta=meta)
+                    self._update_gauges()
+                    return True
         if self.queue_limit and len(self._queue) >= self.queue_limit:
             self._shed += 1
             self._inc("serve_shed")
             self._update_gauges()
             return False
+        # NOTE: a lookup that found nothing is NOT counted a miss here —
+        # the request may yet shed, expire pre-admission, be rejected at
+        # drain, or exhaust its admit retries without ever decoding.
+        # The miss is counted at _harvest, beside the write-back, so
+        # misses == write-backs exactly (the hit-rate arithmetic
+        # serve_report renders; test-pinned).
         ttl = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         deadline = (self.clock() + ttl / 1e3) if ttl and ttl > 0 else None
         self._queue.append(Request(request_id, feats,
-                                   arrival=self.clock(), meta=meta,
-                                   index=index, deadline=deadline))
+                                   arrival=arrival, meta=meta,
+                                   index=index, deadline=deadline,
+                                   stream=bool(stream),
+                                   cache_key=cache_key))
         self._update_gauges()
         return True
 
+    def _complete_hit(self, request_id, row: np.ndarray, arrival: float,
+                      *, stream: bool, meta: Optional[dict]) -> None:
+        """A cache hit completes at submit time: zero admissions, zero
+        chunk dispatches (asserted by the cache tests against
+        ``serve_admitted`` / ``chunk_dispatches``).  Streamed hits emit
+        their whole caption as one terminal chunk first."""
+        now = self.clock()
+        chunks = 0
+        ttft = None
+        if stream:
+            trimmed = _trim_eos(row)
+            if trimmed.size:
+                self._stream_chunks.append(
+                    StreamChunk(request_id, 0, trimmed, meta=meta))
+                self._stream_emitted += 1
+                self._inc("serve_stream_chunks")
+                chunks = 1
+                ttft = now - arrival
+                self._ttft.append(ttft)
+                self._observe("serve_ttft_ms", ttft * 1e3)
+        comp = Completion(
+            request_id=request_id, tokens=row, slot=-1,
+            admit_at=now, done_at=now, latency_s=now - arrival,
+            decode_steps=0, meta=meta, stream_chunks=chunks,
+            ttft_s=ttft, cache_hit=True)
+        self._hits.append(comp)
+        self._completed += 1
+        self._inc("serve_completed")
+        self._latencies.append(comp.latency_s)
+        self._observe("serve_request_latency_ms", comp.latency_s * 1e3)
+
     @property
     def idle(self) -> bool:
-        return not self._queue and not any(self._residents)
+        return (not self._queue and not any(self._residents)
+                and not self._hits)
 
     @property
     def resident_count(self) -> int:
@@ -528,6 +710,13 @@ class ServingEngine:
         accumulated since the last call; the front end answers each with
         an explicit per-request error response."""
         out, self._dropped = self._dropped, []
+        return out
+
+    def pop_stream_chunks(self) -> List[StreamChunk]:
+        """Drain the incremental caption chunks accumulated since the
+        last call (streamed requests only); the front end writes each as
+        a ``"stream": true`` JSONL line BEFORE the final response."""
+        out, self._stream_chunks = self._stream_chunks, []
         return out
 
     # -- deadlines ---------------------------------------------------------
@@ -700,6 +889,7 @@ class ServingEngine:
                         f"{res.request.index} resident in slot {slot}")
         with trace_span(self._tracer, "serve.decode_chunk"):
             t0 = time.perf_counter()
+            self._chunk_dispatches += 1
             new_dev, extras = programs["chunk"](self._variables, self._dev)
             # The per-row predicate — the finished_mask helper the
             # early-exit chunks share — reduced on device, fetched once.
@@ -825,11 +1015,13 @@ class ServingEngine:
         harvest every row whose per-row finished mask went True (freeing
         its slot), expire again, refill.  Returns the completions
         harvested this step (possibly []); drop records accumulate for
-        ``pop_dropped``."""
+        ``pop_dropped``.  Cache hits completed since the last step are
+        returned first (they never occupied a slot)."""
+        done: List[Completion] = list(self._hits)
+        self._hits.clear()
         self._expire_residents(self.clock())
         self._ensure_bucket()
         self._admit_pending()
-        done: List[Completion] = []
         if self.resident_count == 0:
             self._update_gauges()
             return done
@@ -844,6 +1036,12 @@ class ServingEngine:
             if pars is not None:
                 res.pars.append(pars[slot])
             res.steps += self.chunk
+            if res.request.stream and k == 1:
+                # Greedy streams honestly: this chunk's emitted tokens
+                # are final the moment they leave the device.  (Beam
+                # emits its one terminal chunk inside _harvest — the
+                # best hypothesis needs the backtrack.)
+                self._emit_stream_delta(res)
             if fin[slot] or res.steps >= self.max_len:
                 if k > 1 and scores_h is None:
                     # cstlint: disable=device-scalar-fetch -- the designed batched harvest: ONE lazy fetch of all slots' beam scores per chunk (only when some slot finished), not per-step scalars; the host backtrack needs them.
@@ -859,17 +1057,69 @@ class ServingEngine:
         self._update_gauges()
         return done
 
+    # -- streaming ---------------------------------------------------------
+
+    def _caption_so_far(self, res: _Resident) -> np.ndarray:
+        """The resident's caption tokens as of the latest chunk: the
+        harvested chunks only, clamped at max_len, trimmed at the first
+        EOS — exactly the tokens the final harvest will keep.  NOT
+        ``res.prefix``: a rebuild's deterministic replay re-derives the
+        prefix tokens INTO ``res.toks`` from step 0 (harvest's
+        ``all_toks`` reads only ``res.toks`` for the same reason), so
+        prepending the prefix would double-count everything streamed
+        before the rebuild."""
+        if not res.toks:
+            return np.zeros((0,), np.int32)
+        return _trim_eos(np.concatenate(res.toks, axis=0)[:self.max_len])
+
+    def _emit_stream_delta(self, res: _Resident) -> None:
+        """Queue the resident's NEW caption tokens (beyond what was
+        already streamed) as one chunk.  Empty deltas emit nothing —
+        and after a rebuild the deterministic replay's re-derived tokens
+        fall inside the ``streamed`` watermark, so clients never see
+        duplicates.  The watermark only ever moves FORWARD: mid-replay
+        the re-derived caption is shorter than what was already emitted,
+        and shrinking it would re-stream the tail once the replay caught
+        up."""
+        cap = self._caption_so_far(res)
+        new = cap[res.streamed:]
+        res.streamed = max(res.streamed, int(cap.size))
+        if not new.size:
+            return
+        self._push_stream_chunk(res, new)
+
+    def _push_stream_chunk(self, res: _Resident, tokens: np.ndarray) -> None:
+        now = self.clock()
+        if res.chunks_emitted == 0:
+            res.first_emit = now
+            ttft = now - res.request.arrival
+            self._ttft.append(ttft)
+            self._observe("serve_ttft_ms", ttft * 1e3)
+        else:
+            gap = now - res.last_emit
+            self._gaps.append(gap)
+            self._observe("serve_chunk_gap_ms", gap * 1e3)
+        res.last_emit = now
+        self._stream_chunks.append(
+            StreamChunk(res.request.request_id, res.chunks_emitted,
+                        np.asarray(tokens, np.int32), meta=res.request.meta))
+        res.chunks_emitted += 1
+        self._stream_emitted += 1
+        self._inc("serve_stream_chunks")
+
     def _harvest(self, slot: int, scores_h, lengths_h) -> Completion:
         res = self._residents[slot]
         self._residents[slot] = None
         max_len = self.max_len
         all_toks = np.concatenate(res.toks, axis=0)
+        diverged = False
         if res.prefix is not None:
             # Replay-verification: a post-rebuild re-decode is the same
             # deterministic program on the same inputs, so the re-emitted
             # tokens must reproduce the persisted prefix bit for bit.
             n = min(len(res.prefix), len(all_toks))
             if not np.array_equal(all_toks[:n], res.prefix[:n]):
+                diverged = True
                 self._inc("serve_replay_divergence")
                 self._replay_divergence += 1
                 log.warning("request %r: post-rebuild replay diverged "
@@ -885,12 +1135,37 @@ class ServingEngine:
             row = _backtrack_best(toks, pars, scores_h[slot],
                                   lengths_h[slot], max_len,
                                   self.length_norm)
+            if res.request.stream:
+                # Beam's one honest chunk: the backtracked winner, whole.
+                trimmed = _trim_eos(row)
+                if trimmed.size:
+                    self._push_stream_chunk(res, trimmed)
+        if res.request.cache_key is not None and self._result_cache \
+                is not None:
+            if diverged:
+                # A replay-diverged caption is SUSPECT: never cache it
+                # (and drop any entry a concurrent twin wrote) — the
+                # cache may make a request cheaper, never wronger.
+                self._result_cache.invalidate(res.request.cache_key)
+            else:
+                # The miss is counted HERE, beside its write-back:
+                # misses == write-backs exactly (submit's note).
+                self._cache_misses += 1
+                self._inc("serve_cache_misses")
+                evicted = self._result_cache.put(res.request.cache_key,
+                                                 row)
+                if evicted:
+                    self._cache_evictions += evicted
+                    self._inc("serve_cache_evictions", evicted)
         now = self.clock()
         comp = Completion(
             request_id=res.request.request_id, tokens=row, slot=slot,
             admit_at=res.admit_at, done_at=now,
             latency_s=now - res.request.arrival,
-            decode_steps=min(res.steps, max_len), meta=res.request.meta)
+            decode_steps=min(res.steps, max_len), meta=res.request.meta,
+            stream_chunks=res.chunks_emitted,
+            ttft_s=(None if res.first_emit is None
+                    else res.first_emit - res.request.arrival))
         self._completed += 1
         self._inc("serve_completed")
         self._latencies.append(comp.latency_s)
@@ -914,7 +1189,8 @@ class ServingEngine:
         if rejected:
             self._rejected += len(rejected)
             self._inc("serve_rejected_drain", len(rejected))
-        done: List[Completion] = []
+        done: List[Completion] = list(self._hits)  # cache hits owe nothing
+        self._hits.clear()
         while any(r is not None for r in self._residents):
             if abort is not None and abort():
                 log.warning("drain aborted with %d resident(s) unfinished",
@@ -967,12 +1243,47 @@ class ServingEngine:
             "shed": self._shed,
             "rejected_drain": self._rejected,
             "compiles": self._cache.builds,
+            "chunk_dispatches": self._chunk_dispatches,
             "latency_p50_ms": pct(50),
             "latency_p99_ms": pct(99),
             "latency_mean_ms": float(lat.mean()) if lat.size else None,
             # Fault-tolerance audit (host mirrors of the registry
             # counters, so stats are complete registry-less too).
             **self.recovery_counters(),
+            **self.cache_counters(),
+            **self.stream_stats(),
+        }
+
+    def cache_counters(self) -> Dict[str, Any]:
+        """The ONE definition of the result-cache audit view (the
+        recovery_counters discipline: stats, probe, and serve_report all
+        render exactly this dict)."""
+        armed = self._result_cache is not None
+        return {
+            "cache_armed": armed,
+            "cache_hits": self._cache_hits,
+            "cache_misses": self._cache_misses,
+            "cache_evictions": self._cache_evictions,
+            "cache_bypass": self._cache_bypass,
+            "cache_errors": self._cache_errors,
+            "cache_entries": len(self._result_cache) if armed else 0,
+            "cache_capacity": (self._result_cache.capacity if armed
+                               else 0),
+        }
+
+    def stream_stats(self) -> Dict[str, Any]:
+        """Streaming latency view: time-to-first-token and inter-chunk
+        gap percentiles over the retained emission windows."""
+        ttft = np.asarray(self._ttft, np.float64) * 1e3
+        gaps = np.asarray(self._gaps, np.float64) * 1e3
+        p = (lambda a, q: round(float(np.percentile(a, q)), 3)
+             if a.size else None)
+        return {
+            "stream_chunks": self._stream_emitted,
+            "ttft_p50_ms": p(ttft, 50),
+            "ttft_p99_ms": p(ttft, 99),
+            "chunk_gap_p50_ms": p(gaps, 50),
+            "chunk_gap_p99_ms": p(gaps, 99),
         }
 
     def recovery_counters(self) -> Dict[str, int]:
@@ -1038,6 +1349,15 @@ class ServingEngine:
                                      float(np.percentile(lat, 50)))
             self._registry.set_gauge("serve_latency_p99_ms",
                                      float(np.percentile(lat, 99)))
+
+
+def _trim_eos(tokens: np.ndarray) -> np.ndarray:
+    """Caption tokens up to (excluding) the first EOS/PAD 0 — the slice
+    ``vocab.decode`` reads, shared by the streaming deltas and the
+    cache-hit terminal chunk so "the caption's tokens" has one meaning."""
+    t = np.asarray(tokens, np.int32).reshape(-1)
+    nz = np.flatnonzero(t == 0)
+    return t[: int(nz[0])] if nz.size else t
 
 
 def _backtrack_best(toks: np.ndarray, pars: np.ndarray, scores: np.ndarray,

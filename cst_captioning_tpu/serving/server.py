@@ -3,9 +3,17 @@
 Protocol (one JSON object per line, either direction):
 
   request:   {"id": <any>, "video_id": "<key>"}
-             optional: "op": "caption" (default) | "health",
-                       "deadline_ms": <per-request TTL override>
+             optional: "op": "caption" (default) | "stream" | "health",
+                       "deadline_ms": <per-request TTL override>,
+                       "no_cache": true  (skip the exact-result cache)
   response:  {"id", "video_id", "caption", "latency_ms", "decode_steps"}
+             (cache hits add "cached": true; streamed finals add
+             "stream": true, "final": true, "chunks": N, "ttft_ms")
+  stream:    {"id", "video_id", "stream": true, "seq": k,
+              "tokens": [..], "text": "<new words>", "final": false}
+             — one line per scheduler chunk as the resident's new tokens
+             are harvested; the concatenation of the "text" fragments is
+             the final caption (SERVING.md "Streaming & result cache")
   health:    {"op": "health", "status": "ok"|"degraded"|"draining",
               "queue_depth", "residents", "recovery": {...}}
   reject:    {"id", "error": "shed" | "bad_request" | "unknown_video"
@@ -55,7 +63,7 @@ import numpy as np
 from ..utils.locksan import LockOrderViolation, declare_order, named_lock
 from ..resilience.exitcodes import EXIT_OK, EXIT_PREEMPTED, EXIT_SIGTERM
 from ..resilience.garble import health_status
-from .engine import Completion, Dropped, ServingEngine
+from .engine import Completion, Dropped, ServingEngine, StreamChunk
 
 log = logging.getLogger("cst_captioning_tpu.serving.server")
 
@@ -111,24 +119,66 @@ class CaptionServer:
         with self._write_lock:
             respond(json.dumps(obj))
 
+    @staticmethod
+    def _mark_stream_terminal(obj: Dict[str, Any], streamed) -> Dict[str, Any]:
+        """The ONE source of the protocol invariant that every streamed
+        request's LAST line carries ``"final": true`` — applied at every
+        terminal write (completion, drop, shed, drain reject) so a
+        client reading chunks until the terminal can never hang."""
+        if streamed:
+            obj["stream"] = True
+            obj["final"] = True
+        return obj
+
     def _respond_completion(self, comp: Completion) -> None:
         meta = comp.meta or {}
         respond = meta.get("respond", self._stdout_respond)
-        self._write(respond, {
+        obj = {
             "id": meta.get("id"),
             "video_id": meta.get("video_id"),
             "caption": self.vocab.decode(comp.tokens),
             "latency_ms": round(comp.latency_s * 1e3, 3),
             "decode_steps": int(comp.decode_steps),
+        }
+        if comp.cache_hit:
+            obj["cached"] = True
+        if meta.get("stream"):
+            # The terminal line of a streamed response: carries the full
+            # caption (authoritative — equal to the concatenated chunks).
+            obj["stream"] = True
+            obj["final"] = True
+            obj["chunks"] = int(comp.stream_chunks)
+            if comp.ttft_s is not None:
+                obj["ttft_ms"] = round(comp.ttft_s * 1e3, 3)
+        self._write(respond, obj)
+
+    def _respond_stream_chunk(self, chunk: StreamChunk) -> None:
+        meta = chunk.meta or {}
+        respond = meta.get("respond", self._stdout_respond)
+        self._write(respond, {
+            "id": meta.get("id"),
+            "video_id": meta.get("video_id"),
+            "stream": True,
+            "seq": int(chunk.seq),
+            "tokens": [int(t) for t in chunk.tokens],
+            "text": self.vocab.decode(chunk.tokens),
+            "final": False,
         })
+
+    def _respond_stream_all(self) -> bool:
+        chunks = self.engine.pop_stream_chunks()
+        for chunk in chunks:
+            self._respond_stream_chunk(chunk)
+        return bool(chunks)
 
     def _respond_dropped(self, drop: Dropped) -> None:
         meta = drop.meta or {}
         respond = meta.get("respond", self._stdout_respond)
         error = ("admit_failed" if drop.reason == "admit_failed"
                  else "expired")
-        obj = {"id": meta.get("id"), "video_id": meta.get("video_id"),
-               "error": error}
+        obj = self._mark_stream_terminal(
+            {"id": meta.get("id"), "video_id": meta.get("video_id"),
+             "error": error}, meta.get("stream"))
         if drop.reason == "expired":
             obj["where"] = drop.where              # "queued" | "resident"
         elif drop.reason == "deadline_shed":
@@ -214,13 +264,21 @@ class CaptionServer:
                 self.registry.inc("serve_health_queries")
             self._write(respond, self.health_payload())
             return
-        if op != "caption":
+        if op not in ("caption", "stream"):
             self._count_bad_line()
             self._write(respond, {"id": req.get("id"), "error": "unknown_op",
                                   "op": op,
-                                  "detail": "expected op 'caption' or "
-                                            "'health'"})
+                                  "detail": "expected op 'caption', "
+                                            "'stream' or 'health'"})
             return
+        stream = (op == "stream")
+        if stream and self.engine.chunk >= self.engine.max_len:
+            # --decode_chunk 0 ran the rollout as one max_len-sized
+            # chunk: streaming degenerates to a single terminal chunk.
+            # Warn ONCE (opts.py owns the warn-once discipline).
+            from ..opts import warn_stream_legacy_scan
+
+            warn_stream_legacy_scan()
         rid = req.get("id")
         vid = req.get("video_id")
         if vid is None:
@@ -248,18 +306,20 @@ class CaptionServer:
         try:
             ok = self.engine.submit(
                 (rid, vid), [np.asarray(f) for f in feats],
-                meta={"id": rid, "video_id": vid, "respond": respond},
-                deadline_ms=deadline_ms)
+                meta={"id": rid, "video_id": vid, "respond": respond,
+                      "stream": stream},
+                deadline_ms=deadline_ms, stream=stream,
+                no_cache=bool(req.get("no_cache")))
         except ValueError as e:
             self._count_bad_line()
             self._write(respond, {"id": rid, "error": "bad_request",
                                   "detail": str(e)})
             return
         if not ok:
-            self._write(respond, {"id": rid, "error": "shed",
-                                  "video_id": vid,
-                                  "queue_depth": self.engine.stats()
-                                  ["queue_depth"]})
+            self._write(respond, self._mark_stream_terminal(
+                {"id": rid, "error": "shed", "video_id": vid,
+                 "queue_depth": self.engine.stats()["queue_depth"]},
+                stream))
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -279,6 +339,7 @@ class CaptionServer:
               "signal aborts", file=sys.stderr)
         sys.stderr.flush()
         done, rejected = self.engine.drain(abort=aborted)
+        self._respond_stream_all()     # chunks before their finals
         for comp in done:
             self._respond_completion(comp)
         self._respond_dropped_all()
@@ -291,9 +352,11 @@ class CaptionServer:
         for req in rejected + abandoned:
             meta = req.meta or {}
             self._write(meta.get("respond", self._stdout_respond),
-                        {"id": meta.get("id"),
-                         "video_id": meta.get("video_id"),
-                         "error": "rejected_draining"})
+                        self._mark_stream_terminal(
+                            {"id": meta.get("id"),
+                             "video_id": meta.get("video_id"),
+                             "error": "rejected_draining"},
+                            meta.get("stream")))
         if aborted():
             print(f"serve: drain aborted by a second signal with "
                   f"{unfinished} resident(s) unfinished; exiting "
@@ -319,6 +382,10 @@ class CaptionServer:
                 self._handle_line(line, respond)
                 moved = True
             comps = self.engine.step()
+            # Stream chunks first: a request's incremental lines must
+            # precede its final ("final": true) response.
+            if self._respond_stream_all():
+                moved = True
             for comp in comps:
                 self._respond_completion(comp)
             if comps:

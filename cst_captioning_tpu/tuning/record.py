@@ -65,7 +65,7 @@ def _axis_valid(axis: str, value) -> bool:
     must not smuggle in a value the flag parser would reject with a
     usage error (e.g. scan_unroll=0 crashing deep inside lax.scan)."""
     if axis == "decode_kernel":
-        return value in ("reference", "pallas")
+        return value in ("reference", "pallas", "bf16")
     if not isinstance(value, int) or isinstance(value, bool):
         return False
     if axis == "scan_unroll":

@@ -110,10 +110,15 @@ def sweep_space(base: argparse.Namespace,
                 point(DEFAULT_DECODE_CHUNK, DEFAULT_SCAN_UNROLL, 1,
                       DEFAULT_OVERLAP_REWARDS, "pallas")]
     points: List[Dict[str, Any]] = []
-    # fused device-reward branch: chunk x unroll x kernel
+    # fused device-reward branch: chunk x unroll x kernel.  "bf16" is the
+    # low-precision decode variant (ops/bf16_decode.py) — parity-gated
+    # for caption quality by scripts/bf16_parity.py; the sweep's job is
+    # the other half of the question: whether it PAYS on this platform
+    # (the record's winner then carries decode_kernel=bf16 with
+    # provenance, exactly like the pallas axis).
     for decode_chunk in (0, 4, 8, 16):
         for scan_unroll in (1, 2):
-            for decode_kernel in ("reference", "pallas"):
+            for decode_kernel in ("reference", "pallas", "bf16"):
                 points.append(point(decode_chunk, scan_unroll, 1,
                                     DEFAULT_OVERLAP_REWARDS, decode_kernel))
     # host reward branch: overlap depth matters only here

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""bf16 decode parity harness: bound the CIDEr delta vs the fp32 path.
+
+``--decode_kernel bf16`` (ops/bf16_decode.py) is a LOW-PRECISION decode
+variant — deliberately not bit-identical — so it ships behind this gate:
+decode the SAME checkpoint's test split with the reference (fp32) cell
+and the bf16 cell, score both against the references, and require the
+CIDEr delta inside the declared bound (``DEFAULT_CIDER_DELTA_BOUND``).
+Within the bound the variant is eligible and the tuner's sweep decides
+whether it pays per platform; outside it the recommendation is PINNED to
+``reference`` (the bit-exact fallback) and the exit code says so.
+
+  # the real gate: a trained checkpoint + its test split
+  python scripts/bf16_parity.py --checkpoint_path <dir> \\
+      --test_feat_h5 ... --test_label_h5 ... --test_info_json ... \\
+      --test_cocofmt_file ... --beam_size 5
+
+  # zero-setup smoke (untrained tiny model on a synthetic split — the
+  # pipeline is real, the CIDEr values are not a quality claim)
+  python scripts/bf16_parity.py --synthetic 1
+
+Prints ONE JSON line — the `parity_gate` verdict plus per-kernel scores
+and token agreement — and exits 0 within the bound, 1 outside it
+(EXIT_FAILURE through the taxonomy).  The cpu512_healthy protocol run of
+this gate is the record of evidence PARITY.md points at.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_synthetic(opt, tmp_root):
+    """Tiny seeded model + synthetic test split -> (model, params, ds,
+    loader).  Untrained weights: the harness exercises the REAL decode +
+    scoring pipeline; the absolute CIDEr values are meaningless and the
+    delta is what the gate reads."""
+    import jax
+
+    from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+    from cst_captioning_tpu.data.loader import CaptionLoader
+    from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+    from cst_captioning_tpu.training.state import (create_train_state,
+                                                   make_optimizer)
+    from cst_captioning_tpu.training.trainer import build_model
+
+    paths = generate(tmp_root, "test", SyntheticSpec(
+        num_videos=8, captions_per_video=3, max_len=opt.max_length,
+        feat_dims=(16, 8), feat_times=(3, 1)))
+    ds = CaptionDataset(SplitPaths(
+        feat_h5=json.loads(paths["feat_h5"]), label_h5=paths["label_h5"],
+        info_json=paths["info_json"], cocofmt_json=paths["cocofmt_json"]))
+    loader = CaptionLoader(ds, batch_size=4, seq_per_img=1, shuffle=False)
+    model = build_model(opt, ds.vocab.size_with_pad, ds.seq_length)
+    tx, _ = make_optimizer()
+    state = create_train_state(
+        model, jax.random.PRNGKey(0),
+        list(zip(ds.feat_times, ds.feat_dims)), ds.seq_length, 1, tx)
+    return model, state.params, ds, loader
+
+
+def main(argv=None) -> int:
+    from cst_captioning_tpu.opts import build_parser
+
+    p = build_parser()
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="1 = zero-setup smoke: untrained tiny model on a "
+                        "generated synthetic split (no checkpoint needed)")
+    p.add_argument("--cider_delta_bound", type=float, default=None,
+                   help="override the declared CIDEr-delta bound "
+                        "(ops/bf16_decode.DEFAULT_CIDER_DELTA_BOUND)")
+    opt = p.parse_args(argv)
+
+    from cst_captioning_tpu.utils.platform import (configure_cli_logging,
+                                                   enable_compile_cache)
+
+    configure_cli_logging(opt.loglevel)
+    enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
+
+    from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+    from cst_captioning_tpu.data.loader import CaptionLoader
+    from cst_captioning_tpu.metrics.coco_eval import language_eval
+    from cst_captioning_tpu.ops.bf16_decode import (
+        DEFAULT_CIDER_DELTA_BOUND,
+        bf16_decode_supported,
+        parity_gate,
+    )
+    from cst_captioning_tpu.resilience.exitcodes import (EXIT_FAILURE,
+                                                         EXIT_OK,
+                                                         EXIT_USAGE)
+    from cst_captioning_tpu.training.evaluation import decode_split
+
+    if opt.synthetic:
+        if opt.rnn_size > 64:
+            # keep the smoke a smoke: the caller can still force big
+            # shapes explicitly, but the bare default must stay seconds
+            opt.rnn_size = opt.input_encoding_size = opt.att_size = 32
+            opt.drop_prob = 0.0
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="bf16_parity_")
+        model, params, ds, loader = build_synthetic(opt, tmp)
+    else:
+        if not opt.test_feat_h5 or not opt.checkpoint_path:
+            print("bf16_parity: need --checkpoint_path and --test_feat_h5/"
+                  "--test_label_h5/--test_info_json/--test_cocofmt_file "
+                  "(or pass --synthetic 1)", file=sys.stderr)
+            return EXIT_USAGE
+        from eval import load_model_for_eval
+
+        ds = CaptionDataset(SplitPaths(
+            feat_h5=list(opt.test_feat_h5), label_h5=opt.test_label_h5,
+            info_json=opt.test_info_json,
+            cocofmt_json=opt.test_cocofmt_file))
+        loader = CaptionLoader(ds, batch_size=opt.batch_size,
+                               seq_per_img=1, shuffle=False)
+        model, params, opt = load_model_for_eval(opt.checkpoint_path, ds,
+                                                 opt)
+
+    bound = (DEFAULT_CIDER_DELTA_BOUND if opt.cider_delta_bound is None
+             else float(opt.cider_delta_bound))
+    ok, reason = bf16_decode_supported(model)
+    try:
+        if not ok:
+            # Nothing to gate: the variant would fall back anyway.
+            out = {"supported": False, "reason": reason,
+                   "kernel_recommendation": "reference"}
+            print(json.dumps(out))
+            return EXIT_OK
+        kw = dict(beam_size=opt.beam_size, length_norm=opt.length_norm,
+                  decode_chunk=getattr(opt, "decode_chunk", 8))
+        preds = {}
+        for kernel in ("reference", "bf16"):
+            m = model.clone(decode_kernel=kernel)
+            preds[kernel] = decode_split(m, params, loader, ds.vocab,
+                                         opt.max_length, **kw)
+        refs = ds.references()
+        scores = {k: language_eval(preds[k], refs, scorers=("CIDEr",))
+                  for k in preds}
+        agree = float(np.mean([
+            a["caption"] == b["caption"]
+            for a, b in zip(preds["reference"], preds["bf16"])]))
+        out = {
+            "supported": True,
+            **parity_gate(scores["reference"]["CIDEr"],
+                          scores["bf16"]["CIDEr"], bound),
+            "caption_agreement": round(agree, 4),
+            "num_videos": len(preds["reference"]),
+            "beam_size": opt.beam_size,
+        }
+        print(json.dumps(out))
+        if not out["within_bound"]:
+            print(f"bf16_parity: CIDEr delta {out['delta']:+.4f} exceeds "
+                  f"the declared bound {bound:g}; the bit-exact "
+                  "'reference' kernel stays the recommendation "
+                  "(ops/bf16_decode.py)", file=sys.stderr)
+            return EXIT_FAILURE
+        return EXIT_OK
+    finally:
+        ds.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

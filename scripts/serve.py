@@ -122,6 +122,7 @@ def main(argv=None) -> int:
     from cst_captioning_tpu.resilience.faults import FaultPlan
     from cst_captioning_tpu.resilience.preemption import PreemptionHandler
     from cst_captioning_tpu.serving.buckets import parse_buckets
+    from cst_captioning_tpu.serving.cache import ResultCache
     from cst_captioning_tpu.serving.engine import (ServingEngine,
                                                    ServingUnrecoverable)
     from cst_captioning_tpu.serving.server import CaptionServer
@@ -172,13 +173,15 @@ def main(argv=None) -> int:
         retry_limit=opt.serve_retry_limit,
         rebuild_limit=opt.serve_rebuild_limit,
         step_budget_ms=opt.serve_step_budget_ms,
+        result_cache=(ResultCache(opt.serve_cache)
+                      if opt.serve_cache else None),
         registry=registry, tracer=tracer)
     engine.warm()
     log.info("engine warm: buckets=%s beam=%d chunk=%d queue_limit=%d "
-             "deadline_ms=%s recover=%d",
+             "deadline_ms=%s recover=%d cache=%d",
              engine.buckets, engine.beam_size, engine.chunk,
              opt.serve_queue_limit, opt.serve_deadline_ms,
-             int(opt.serve_recover))
+             int(opt.serve_recover), int(opt.serve_cache))
 
     server = CaptionServer(engine, vocab, feats_for, handler=handler,
                            registry=registry)

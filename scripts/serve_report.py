@@ -84,6 +84,39 @@ def main(argv=None) -> int:
                     f"{fmt(rec.get('slots'))} slots"),
         ("beam / chunk", f"{fmt(rec.get('beam_size'))} / "
                          f"{fmt(rec.get('decode_chunk'))}"),
+    ]
+    stream = rec.get("stream") or {}
+    if stream.get("enabled"):
+        rows += [
+            ("ttft p50 / p99", f"{fmt(stream.get('ttft_p50_ms'), ' ms')} / "
+                               f"{fmt(stream.get('ttft_p99_ms'), ' ms')}"),
+            ("inter-chunk gap p50 / p99",
+             f"{fmt(stream.get('chunk_gap_p50_ms'), ' ms')} / "
+             f"{fmt(stream.get('chunk_gap_p99_ms'), ' ms')}"),
+            ("stream chunks", f"{fmt(stream.get('chunks'))} "
+                              f"(prefix_ok={stream.get('prefix_ok')})"),
+        ]
+    cache = rec.get("cache") or {}
+    if cache.get("enabled"):
+        hit_rate = cache.get("hit_rate")
+        rows += [
+            ("cache hit rate",
+             ("-" if hit_rate is None else f"{hit_rate * 100:.1f}%")
+             + f" ({fmt(cache.get('hits'))} hits / "
+               f"{fmt(cache.get('misses'))} misses, "
+               f"{fmt(cache.get('evictions'))} evicted, "
+               f"{fmt(cache.get('bypass'))} bypassed, "
+               f"{fmt(cache.get('errors'))} errors)"),
+            ("cache drill", f"parity_ok={cache.get('parity_ok')} "
+                            f"({fmt(cache.get('parity_mismatches'))} "
+                            "hit/miss-twin mismatches)"),
+        ]
+        if rec.get("cache_off_captions_per_sec") is not None:
+            rows.append(
+                ("cache-off twin", f"{fmt(rec.get('cache_off_captions_per_sec'))}"
+                                   " caps/s (speedup "
+                                   f"{fmt(rec.get('cache_speedup'))}x)"))
+    rows += [
         ("recompiles after warmup", fmt(rec.get("recompiles_after_warmup"))),
         ("expired / deadline-shed", f"{fmt(rec.get('expired'))} / "
                                     f"{fmt(rec.get('deadline_shed'))}"),
@@ -112,6 +145,25 @@ def main(argv=None) -> int:
         print("  !! an engine rebuild compiled new programs: recovery "
               "must re-warm from the existing ProgramCache "
               "(RESILIENCE.md 'Serving faults')", file=sys.stderr)
+        rc = 1
+    if cache.get("enabled") and cache.get("parity_ok") is False:
+        print("  !! cache-hit caption(s) not bit-identical to their miss "
+              "twin in the drill record: the exact-result cache is "
+              "replaying wrong captions (SERVING.md 'Streaming & result "
+              "cache')", file=sys.stderr)
+        rc = 1
+    twin_cps = rec.get("cache_off_captions_per_sec")
+    if cache.get("enabled") and twin_cps is not None \
+            and rec.get("value") is not None \
+            and rec["value"] <= twin_cps:
+        print("  !! the cached probe did not beat its cache-off twin "
+              f"({rec['value']} <= {twin_cps} caps/s): the result cache "
+              "is not paying on this run", file=sys.stderr)
+        rc = 1
+    if stream.get("enabled") and stream.get("prefix_ok") is False:
+        print("  !! streamed chunks are not prefix-consistent with the "
+              "final captions (SERVING.md 'Streaming & result cache')",
+              file=sys.stderr)
         rc = 1
     return rc
 

@@ -45,6 +45,7 @@ os.environ["CST_TUNED_CONFIGS"] = ""
 os.environ["CST_SERVE_BUCKETS"] = ""
 os.environ["CST_SERVE_QUEUE_LIMIT"] = ""
 os.environ["CST_SERVE_DEADLINE_MS"] = ""
+os.environ["CST_SERVE_CACHE"] = ""
 
 import jax  # noqa: E402
 

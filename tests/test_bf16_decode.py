@@ -1,0 +1,262 @@
+"""Low-precision decode variant (ISSUE 12): --decode_kernel bf16.
+
+Fast slice (tier-1):
+- routing: make_decode_step serves the bf16 step for eligible models and
+  falls back (warn-once) to the bit-exact reference cell for ineligible
+  ones — the pallas fallback discipline;
+- boundary contract: fp32 carry in/out, fp32 logits, logits close to the
+  fp32 path (the variant changes precision, not formulation);
+- serving parity PER KERNEL: the engine under decode_kernel=bf16 serves
+  captions bit-identical to the offline bf16 decode (the engine changes
+  scheduling, never captions — for every kernel);
+- the parity gate: within the declared CIDEr-delta bound -> "bf16",
+  outside -> "reference" pinned as the fallback;
+- the sweep grid carries the bf16 axis so TUNED_CONFIGS.json can record
+  a per-platform winner;
+- program/result-cache identity: bf16 and reference engines never share
+  compiled programs or cached captions.
+
+The end-to-end CLI gate (scripts/bf16_parity.py --synthetic) is marked
+slow; `make bf16-parity` runs it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.bf16_decode import (
+    DEFAULT_CIDER_DELTA_BOUND,
+    bf16_decode_supported,
+    make_bf16_decode_step,
+    parity_gate,
+)
+from cst_captioning_tpu.ops.sampling import make_decode_step, sample_captions
+from cst_captioning_tpu.serving.engine import ServingEngine
+
+V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build(decode_kernel="reference", dtype=jnp.float32):
+    return CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                        attn_size=16, dropout_rate=0.0,
+                        decode_kernel=decode_kernel, dtype=dtype)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = build()
+    feats_np = np.random.default_rng(0).normal(
+        size=(B, T, D)).astype(np.float32) * 2.0
+    variables = model.init(jax.random.PRNGKey(0), [jnp.asarray(feats_np)],
+                           np.zeros((B, MAX_LEN), np.int32))
+    return model, variables, feats_np
+
+
+def encodings(model, variables, feats_np):
+    memory, proj_mem, pooled = model.apply(
+        variables, [jnp.asarray(feats_np)], method="encode")
+    carry = model.apply(variables, pooled, MAX_LEN, method="init_carry")
+    return memory, proj_mem, pooled, carry
+
+
+# -- eligibility + routing -------------------------------------------------
+
+
+def test_supported_gate():
+    ok, _ = bf16_decode_supported(build())
+    assert ok
+    ok, reason = bf16_decode_supported(build(dtype=jnp.bfloat16))
+    assert not ok and "already bfloat16" in reason
+
+
+def test_ineligible_model_falls_back_bit_exact(setup, caplog):
+    """An already-bf16 model under decode_kernel=bf16 routes to the
+    reference cell (bit-identical decode) with ONE warning."""
+    _, variables, feats_np = setup
+    import cst_captioning_tpu.ops.bf16_decode as mod
+
+    mod._warned_fallback.clear()
+    kw = dict(rng=jax.random.PRNGKey(0), max_len=MAX_LEN, greedy=True)
+    with caplog.at_level("WARNING"):
+        got, _ = sample_captions(build("bf16", jnp.bfloat16), variables,
+                                 [jnp.asarray(feats_np)], kw["rng"],
+                                 MAX_LEN, greedy=True)
+        ref, _ = sample_captions(build("reference", jnp.bfloat16),
+                                 variables, [jnp.asarray(feats_np)],
+                                 kw["rng"], MAX_LEN, greedy=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    warns = [r for r in caplog.records
+             if "falling back to the reference decode cell" in r.message]
+    assert len(warns) == 1                      # warn-once per reason
+
+
+def test_step_boundary_contract(setup):
+    """fp32 carry in -> fp32 carry out, fp32 logits, values close to the
+    fp32 reference step (precision, not formulation, changed)."""
+    model, variables, feats_np = setup
+    memory, proj_mem, pooled, carry = encodings(model, variables, feats_np)
+    ref_step = make_decode_step(model, variables, memory, proj_mem, pooled)
+    bf_step = make_bf16_decode_step(model, variables, memory, proj_mem,
+                                    pooled)
+    tok = jnp.zeros((B,), jnp.int32)
+    (c_ref, l_ref), (c_bf, l_bf) = ref_step(carry, tok), bf_step(carry, tok)
+    assert l_bf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(c_bf):
+        assert leaf.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(l_bf), np.asarray(l_ref),
+                               atol=0.15, rtol=0.1)
+
+
+def test_routing_via_model_attr(setup):
+    """make_decode_step keys off model.decode_kernel — the same routing
+    the samplers, beam, eval, and the serving engine all share."""
+    model, variables, feats_np = setup
+    memory, proj_mem, pooled, carry = encodings(model, variables, feats_np)
+    step = make_decode_step(build("bf16"), variables, memory, proj_mem,
+                            pooled)
+    twin = make_bf16_decode_step(model, variables, memory, proj_mem,
+                                 pooled)
+    tok = jnp.zeros((B,), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(step(carry, tok)[1]),
+                                  np.asarray(twin(carry, tok)[1]))
+
+
+# -- serving parity under the bf16 kernel ----------------------------------
+
+
+def test_serving_engine_bf16_bit_identical_to_offline(setup):
+    _, variables, feats_np = setup
+    model = build("bf16")
+    offline, _ = sample_captions(model, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN,
+                                 greedy=True)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0)
+    for i in range(B):
+        engine.submit(i, [feats_np[i]])
+    got = {c.request_id: c.tokens for c in engine.run_until_idle()}
+    np.testing.assert_array_equal(np.stack([got[i] for i in range(B)]),
+                                  np.asarray(offline))
+
+
+def test_program_and_result_cache_identity_split(setup):
+    """bf16 and reference engines share neither compiled programs nor
+    cached captions: decode_kernel is part of both identities."""
+    from cst_captioning_tpu.serving.cache import ResultCache
+
+    _, variables, feats_np = setup
+    cache = ResultCache(8)
+    e_ref = ServingEngine(build("reference"), variables, [(T, D)],
+                          max_len=MAX_LEN, decode_chunk=2,
+                          bucket_sizes=(1,), queue_limit=0,
+                          result_cache=cache)
+    assert e_ref._config_key(1, "programs") != \
+        ServingEngine(build("bf16"), variables, [(T, D)],
+                      max_len=MAX_LEN, decode_chunk=2, bucket_sizes=(1,),
+                      queue_limit=0)._config_key(1, "programs")
+    e_ref.submit(0, [feats_np[0]])
+    e_ref.run_until_idle()
+    e_bf = ServingEngine(build("bf16"), variables, [(T, D)],
+                         max_len=MAX_LEN, decode_chunk=2, bucket_sizes=(1,),
+                         queue_limit=0, result_cache=cache)
+    e_bf.submit(0, [feats_np[0]])
+    e_bf.run_until_idle()
+    s = e_bf.stats()
+    assert s["cache_hits"] == 0 and s["cache_misses"] == 1
+
+
+def test_transformer_decoder_bf16_step(setup):
+    """The bf16 variant serves the transformer decoder too: its int32
+    (token-buffer, position) carry leaves keep their dtype through the
+    boundary casts (regression: a blind astype crashed
+    dynamic_update_slice), and the step output tracks the fp32 path."""
+    _, __, feats_np = setup
+    kw = dict(vocab_size=V, embed_size=16, hidden_size=16, attn_size=16,
+              dropout_rate=0.0, decoder_type="transformer", num_heads=2,
+              num_tx_layers=1, tx_max_len=MAX_LEN)
+    ref = CaptionModel(**kw)
+    variables = ref.init(jax.random.PRNGKey(0), [jnp.asarray(feats_np)],
+                         np.zeros((B, MAX_LEN), np.int32))
+    out_ref, _ = sample_captions(ref, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN,
+                                 greedy=True)
+    bf = CaptionModel(**kw, decode_kernel="bf16")
+    out_bf, _ = sample_captions(bf, variables, [jnp.asarray(feats_np)],
+                                jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    assert out_bf.shape == out_ref.shape
+    # precision, not formulation: the tiny model's margins are wide
+    # enough that the decodes agree here (not a general guarantee —
+    # that is what the parity gate is for)
+    assert float((np.asarray(out_bf) == np.asarray(out_ref)).mean()) > 0.9
+
+
+# -- the parity gate -------------------------------------------------------
+
+
+def test_parity_gate_decision_rule():
+    ok = parity_gate(3.10, 3.095)
+    assert ok["within_bound"] and ok["kernel_recommendation"] == "bf16"
+    assert ok["delta"] == pytest.approx(-0.005)
+    assert ok["bound"] == DEFAULT_CIDER_DELTA_BOUND
+    bad = parity_gate(3.10, 3.00)              # -0.10 CIDEr: outside
+    assert not bad["within_bound"]
+    assert bad["kernel_recommendation"] == "reference"   # pinned fallback
+    # The bound is two-sided: a suspicious IMPROVEMENT is flagged too
+    # (a low-precision decode that scores better is measuring noise).
+    assert not parity_gate(3.10, 3.20)["within_bound"]
+
+
+def test_opts_and_bench_accept_bf16():
+    from cst_captioning_tpu.opts import parse_opts
+
+    assert parse_opts(["--decode_kernel", "bf16"]).decode_kernel == "bf16"
+
+
+def test_sweep_grid_carries_bf16_axis():
+    from cst_captioning_tpu.tuning.sweep import base_namespace, sweep_space
+
+    points = sweep_space(base_namespace())
+    kernels = {p["decode_kernel"] for p in points}
+    assert kernels == {"reference", "pallas", "bf16"}
+    # Deterministic point order: bf16 points sit in the fused branch.
+    bf16_pts = [p for p in points if p["decode_kernel"] == "bf16"]
+    assert len(bf16_pts) == 8                  # 4 chunks x 2 unrolls
+    assert all(p["device_rewards"] == 1 for p in bf16_pts)
+
+
+# -- the CLI gate (make bf16-parity) ---------------------------------------
+
+
+@pytest.mark.slow
+def test_bf16_parity_cli_synthetic(tmp_path):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bf16_parity.py"),
+         "--synthetic", "1", "--max_length", "8", "--beam_size", "2",
+         "--loglevel", "WARNING"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["supported"] and "delta" in out
+    assert out["kernel_recommendation"] in ("bf16", "reference")
+    # The pinned-fallback path: an impossible bound forces exit 1 with
+    # the bit-exact recommendation.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bf16_parity.py"),
+         "--synthetic", "1", "--max_length", "8", "--beam_size", "2",
+         "--cider_delta_bound", "-1", "--loglevel", "WARNING"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.splitlines()[-1])
+    assert out["kernel_recommendation"] == "reference"
+    assert "reference" in proc.stderr

@@ -1,0 +1,709 @@
+"""Serving latency floor (ISSUE 12): streaming + exact-result cache.
+
+Fast slice (tier-1):
+- PREFIX CONSISTENCY: the concatenation of a streamed request's chunks
+  is bit-identical to its final caption — greedy (per-chunk emission)
+  and beam (one terminal chunk at harvest, the honest formulation);
+- streaming telemetry: TTFT / inter-chunk-gap percentiles on a fake
+  clock, the `serve_stream_chunks` counter, wire format through the
+  in-process CaptionServer (chunk lines strictly before the final);
+- the exact-result cache: a hit is bit-identical to the cold decode and
+  provably skips encoder+decode (serve_admitted / chunk_dispatches
+  unmoved), LRU eviction at capacity, identity-key changes (beam /
+  decode_chunk / params) force a miss, per-request no_cache bypass;
+- the `serve_cache@req=N` chaos drill through the PR 9 recovery plane:
+  the injected lookup failure is absorbed (counted, health degraded)
+  and the caption stays bit-identical to the fault-free twin;
+- the zipfian Poisson probe fast slice (`make serve-stream-bench`'s API
+  twin): hit rate, drill parity record, prefix check, 0 recompiles;
+- scripts/serve_report.py renders the new rows and exits 1 on a
+  hit/miss-twin mismatch or a cache run that loses to its off twin;
+- opts warn-once: `{"op": "stream"}` meeting --decode_chunk 0.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.sampling import sample_captions
+from cst_captioning_tpu.ops.beam import beam_search
+from cst_captioning_tpu.resilience.faults import FaultPlan
+from cst_captioning_tpu.serving.bench import serving_probe, zipfian_mix
+from cst_captioning_tpu.serving.cache import (
+    ResultCache,
+    feature_fingerprint,
+)
+from cst_captioning_tpu.serving.engine import ServingEngine, _trim_eos
+from cst_captioning_tpu.serving.server import CaptionServer
+from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """ISSUE 11 discipline: the serving fast slice runs sanitizer-armed,
+    so the new ``serving.result_cache`` leaf lock is runtime-validated
+    (no nesting, no inversions) under every streaming/cache test."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt from a child process: "
+        f"{receipt.read_text()}")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def make_variables(model, feats, eos_bias=0.4):
+    variables = model.init(jax.random.PRNGKey(0), feats,
+                           np.zeros((B, MAX_LEN), np.int32))
+    params = {**variables["params"]}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(eos_bias)
+    return {"params": params}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    feats_np = np.random.default_rng(0).normal(
+        size=(B, T, D)).astype(np.float32) * 2.0
+    variables = make_variables(model, [jnp.asarray(feats_np)])
+    return model, variables, feats_np
+
+
+def run_streamed(engine, ids):
+    comps, chunks = [], {}
+    while not engine.idle:
+        comps.extend(engine.step())
+        for ch in engine.pop_stream_chunks():
+            chunks.setdefault(ch.request_id, []).append(ch)
+    return {c.request_id: c for c in comps}, chunks
+
+
+# -- prefix consistency (the streaming acceptance bar) ---------------------
+
+
+def test_greedy_stream_prefix_consistent(setup):
+    """Concatenating a streamed request's chunks reproduces the final
+    caption bit for bit — and the final caption is the offline decode."""
+    model, variables, feats_np = setup
+    offline, _ = sample_captions(model, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0)
+    for i in range(B):
+        assert engine.submit(i, [feats_np[i]], stream=True)
+    comps, chunks = run_streamed(engine, range(B))
+    assert sorted(comps) == list(range(B))
+    multi = 0
+    for i in range(B):
+        np.testing.assert_array_equal(comps[i].tokens,
+                                      np.asarray(offline)[i])
+        got = chunks.get(i, [])
+        assert [c.seq for c in got] == list(range(len(got)))
+        cat = (np.concatenate([c.tokens for c in got])
+               if got else np.zeros((0,), np.int32))
+        np.testing.assert_array_equal(cat, _trim_eos(comps[i].tokens))
+        assert comps[i].stream_chunks == len(got)
+        multi += len(got) > 1
+    # The fixture's mild EOS bias leaves most captions running several
+    # chunks — the test must prove real incremental emission, not just
+    # the degenerate one-chunk case.
+    assert multi >= 1
+    # No chunk ever carries an EOS/pad 0.
+    assert all((c.tokens != 0).all() for lst in chunks.values()
+               for c in lst)
+
+
+def test_beam_stream_single_terminal_chunk(setup):
+    """Beam cannot stream honestly, so a streamed beam request emits
+    EXACTLY one terminal chunk whose tokens are the backtracked winner."""
+    model, variables, feats_np = setup
+    best, _, _ = beam_search(model, variables, [jnp.asarray(feats_np)],
+                             beam_size=3, max_len=MAX_LEN, length_norm=0.7)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           beam_size=3, length_norm=0.7, decode_chunk=2,
+                           bucket_sizes=(2,), queue_limit=0)
+    for i in range(B):
+        assert engine.submit(i, [feats_np[i]], stream=True)
+    comps, chunks = run_streamed(engine, range(B))
+    for i in range(B):
+        np.testing.assert_array_equal(comps[i].tokens, np.asarray(best)[i])
+        got = chunks.get(i, [])
+        assert len(got) <= 1          # one terminal chunk (0 if empty)
+        cat = (got[0].tokens if got else np.zeros((0,), np.int32))
+        np.testing.assert_array_equal(cat, _trim_eos(comps[i].tokens))
+
+
+def test_stream_ttft_and_gap_metrics_fake_clock(setup):
+    """TTFT = first-chunk emission minus arrival; gaps between chunk
+    emissions — deterministic on the fake clock, and exported through
+    stats() and the registry histograms."""
+    model, variables, feats_np = setup
+    registry = MetricsRegistry()
+    clock = FakeClock()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           registry=registry, clock=clock)
+    engine.submit(0, [feats_np[1]], stream=True)   # row 1: runs full length
+    clock.tick(3.0)
+    done = []
+    while not engine.idle:
+        done.extend(engine.step())
+        clock.tick(1.0)
+    comp = done[0]
+    assert comp.stream_chunks >= 2
+    # Arrival at t=0; the scheduler ran its first chunk at t=3.
+    assert comp.ttft_s == pytest.approx(3.0)
+    stats = engine.stats()
+    assert stats["stream_chunks"] == comp.stream_chunks
+    assert stats["ttft_p50_ms"] == pytest.approx(3000.0)
+    assert stats["chunk_gap_p50_ms"] == pytest.approx(1000.0)
+    snap = registry.snapshot()
+    assert snap["counters"]["serve_stream_chunks"] == comp.stream_chunks
+    assert snap["histograms"]["serve_ttft_ms"]["count"] == 1
+    assert snap["histograms"]["serve_chunk_gap_ms"]["count"] == \
+        comp.stream_chunks - 1
+
+
+# -- wire format through the in-process server -----------------------------
+
+
+def test_server_stream_wire_format(setup):
+    model, variables, feats_np = setup
+    from cst_captioning_tpu.data.vocab import Vocab
+
+    vocab = Vocab({i: f"w{i}" for i in range(1, V)})
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           result_cache=ResultCache(4))
+    out = io.StringIO()
+    server = CaptionServer(engine, vocab,
+                           lambda vid: [feats_np[int(vid)]], out=out)
+    rc = server.run_stdin([json.dumps({"id": 1, "video_id": "1",
+                                       "op": "stream"}),
+                           json.dumps({"id": 2, "video_id": "2"})])
+    assert rc == 0
+    replies = [json.loads(l) for l in out.getvalue().splitlines()]
+    mine = [r for r in replies if r["id"] == 1]
+    final = mine[-1]
+    # Chunk lines strictly precede the final; seq is contiguous.
+    assert final.get("final") is True and final.get("stream") is True
+    parts = mine[:-1]
+    assert all(r["stream"] and r["final"] is False for r in parts)
+    assert [r["seq"] for r in parts] == list(range(len(parts)))
+    assert final["chunks"] == len(parts)
+    assert "ttft_ms" in final or not parts
+    # Text fragments concatenate to the caption; token concat matches.
+    assert " ".join(r["text"] for r in parts if r["text"]) == \
+        final["caption"]
+    # The plain (non-stream) request keeps the historical shape.
+    plain = [r for r in replies if r["id"] == 2][-1]
+    assert "stream" not in plain and "caption" in plain
+
+    # Second server on the SAME engine: the repeat is now a cache hit —
+    # flagged on the wire, still streaming one terminal chunk.
+    out2 = io.StringIO()
+    server2 = CaptionServer(engine, vocab,
+                            lambda vid: [feats_np[int(vid)]], out=out2)
+    rc = server2.run_stdin([json.dumps({"id": 3, "video_id": "1",
+                                        "op": "stream"})])
+    assert rc == 0
+    replies2 = [json.loads(l) for l in out2.getvalue().splitlines()]
+    final2 = replies2[-1]
+    assert final2.get("cached") is True and final2["final"] is True
+    assert final2["caption"] == final["caption"]
+    assert final2["decode_steps"] == 0
+    chunks2 = [r for r in replies2 if r.get("stream") and not r["final"]]
+    assert len(chunks2) <= 1
+    if chunks2:
+        assert " ".join([chunks2[0]["text"]]) == final2["caption"]
+
+
+def test_warn_once_stream_with_decode_chunk_zero(setup, capsys):
+    """Satellite: {"op": "stream"} traffic meeting --decode_chunk 0 warns
+    ONCE, naming the degenerate behavior and the fix."""
+    import cst_captioning_tpu.opts as opts
+
+    model, variables, feats_np = setup
+    from cst_captioning_tpu.data.vocab import Vocab
+
+    vocab = Vocab({i: f"w{i}" for i in range(1, V)})
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=0, bucket_sizes=(2,), queue_limit=0)
+    assert engine.chunk == MAX_LEN                  # legacy one-shot scan
+    opts._warned_stream_legacy = False
+    server = CaptionServer(engine, vocab,
+                           lambda vid: [feats_np[int(vid)]],
+                           out=io.StringIO())
+    server.run_stdin([json.dumps({"id": 1, "video_id": "0",
+                                  "op": "stream"}),
+                      json.dumps({"id": 2, "video_id": "1",
+                                  "op": "stream"})])
+    err = capsys.readouterr().err
+    assert err.count("degenerates to one terminal chunk") == 1  # warn-once
+    assert "--decode_chunk" in err                  # names the fix
+    # chunked engines stay silent
+    opts._warned_stream_legacy = False
+    engine2 = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                            decode_chunk=2, bucket_sizes=(2,),
+                            queue_limit=0)
+    server2 = CaptionServer(engine2, vocab,
+                            lambda vid: [feats_np[int(vid)]],
+                            out=io.StringIO())
+    server2.run_stdin([json.dumps({"id": 1, "video_id": "0",
+                                   "op": "stream"})])
+    assert "degenerates" not in capsys.readouterr().err
+
+
+# -- the exact-result cache ------------------------------------------------
+
+
+def test_cache_hit_bit_identical_and_skips_programs(setup):
+    """Acceptance: a hit returns the cold decode's caption bit for bit
+    and pays ZERO admissions and ZERO chunk dispatches — asserted via
+    the existing registry counter + the engine's dispatch count."""
+    model, variables, feats_np = setup
+    registry = MetricsRegistry()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           result_cache=ResultCache(8), registry=registry)
+    # Declared at 0 before any traffic.
+    snap0 = registry.snapshot()["counters"]
+    for name in ("serve_cache_hits", "serve_cache_misses",
+                 "serve_cache_evictions", "serve_cache_bypass",
+                 "serve_cache_errors", "serve_stream_chunks"):
+        assert snap0[name] == 0
+    for i in range(B):
+        engine.submit(i, [feats_np[i]])
+    cold = {c.request_id: c for c in engine.run_until_idle()}
+    s1 = engine.stats()
+    assert s1["cache_misses"] == B and s1["cache_hits"] == 0
+    admitted1 = registry.snapshot()["counters"]["serve_admitted"]
+    d1 = s1["chunk_dispatches"]
+    # Second wave: every request hits.
+    for i in range(B):
+        engine.submit(100 + i, [feats_np[i]])
+    warm = {c.request_id: c for c in engine.run_until_idle()}
+    s2 = engine.stats()
+    assert s2["cache_hits"] == B
+    assert s2["chunk_dispatches"] == d1                 # zero decode work
+    assert registry.snapshot()["counters"]["serve_admitted"] == admitted1
+    for i in range(B):
+        comp = warm[100 + i]
+        assert comp.cache_hit and comp.decode_steps == 0
+        np.testing.assert_array_equal(comp.tokens, cold[i].tokens)
+    assert s2["completed"] == 2 * B
+
+
+def test_cache_lru_eviction_at_capacity(setup):
+    model, variables, feats_np = setup
+    cache = ResultCache(2)
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           result_cache=cache)
+    for i in range(3):                       # fills 0, 1; decoding 2
+        engine.submit(i, [feats_np[i]])      # evicts 0 (LRU)
+        engine.run_until_idle()
+    s = engine.stats()
+    assert s["cache_evictions"] == 1 and s["cache_entries"] == 2
+    engine.submit(10, [feats_np[0]])         # evicted: miss again
+    engine.run_until_idle()
+    assert engine.stats()["cache_misses"] == 4
+    engine.submit(11, [feats_np[2]])         # still resident: hit
+    engine.run_until_idle()
+    assert engine.stats()["cache_hits"] == 1
+
+
+def test_cache_identity_change_forces_miss(setup):
+    """A shared cache never crosses configurations: beam width,
+    decode_chunk (the bench cache-config identity), or a params change
+    each key a different entry; the same configuration hits."""
+    model, variables, feats_np = setup
+    cache = ResultCache(32)
+
+    def eng(**kw):
+        base = dict(max_len=MAX_LEN, decode_chunk=2, bucket_sizes=(2,),
+                    queue_limit=0, result_cache=cache)
+        base.update(kw)
+        return ServingEngine(model, variables, [(T, D)], **base)
+
+    e1 = eng()
+    e1.submit(0, [feats_np[0]])
+    e1.run_until_idle()
+    assert e1.stats()["cache_misses"] == 1
+
+    same = eng()                              # identical config: HIT
+    same.submit(0, [feats_np[0]])
+    same.run_until_idle()
+    assert same.stats()["cache_hits"] == 1
+
+    for other in (eng(beam_size=2),           # beam change
+                  eng(decode_chunk=4)):       # tuned-axis change
+        other.submit(0, [feats_np[0]])
+        other.run_until_idle()
+        s = other.stats()
+        assert s["cache_hits"] == 0 and s["cache_misses"] == 1
+
+    # A different checkpoint (params fingerprint) must miss too.
+    variables2 = make_variables(model, [jnp.asarray(feats_np)],
+                                eos_bias=-1.0)
+    e2 = ServingEngine(model, variables2, [(T, D)], max_len=MAX_LEN,
+                       decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                       result_cache=cache)
+    e2.submit(0, [feats_np[0]])
+    e2.run_until_idle()
+    assert e2.stats()["cache_hits"] == 0 and e2.stats()["cache_misses"] == 1
+
+
+def test_cache_no_cache_bypass(setup):
+    model, variables, feats_np = setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           result_cache=ResultCache(8))
+    engine.submit(0, [feats_np[0]])
+    engine.run_until_idle()
+    # The miss twin's probe: no_cache skips the lookup AND the write-back
+    # consumes nothing — still decodes, still bit-identical.
+    engine.submit(1, [feats_np[0]], no_cache=True)
+    comps = engine.run_until_idle()
+    s = engine.stats()
+    assert s["cache_bypass"] == 1 and s["cache_hits"] == 0
+    assert not comps[0].cache_hit
+
+
+def test_shed_request_is_not_a_cache_miss(setup):
+    """A shed request never decodes and never writes back, so it must
+    not count as a miss — hits+misses stays the number of lookups that
+    actually led to a decode (the hit-rate arithmetic)."""
+    model, variables, feats_np = setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=2,
+                           result_cache=ResultCache(8))
+    results = [engine.submit(i, [feats_np[i]]) for i in range(4)]
+    assert results == [True, True, False, False]        # 2 shed
+    engine.run_until_idle()
+    s = engine.stats()
+    assert s["shed"] == 2 and s["cache_misses"] == 2    # not 4
+    assert s["cache_entries"] == 2                      # miss == write-back
+
+
+def test_expired_queued_request_is_not_a_cache_miss(setup):
+    """Same invariant on the deadline path: a queued request that
+    expires before admission never decodes, so it is no miss either."""
+    model, variables, feats_np = setup
+    clock = FakeClock()
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0,
+                           result_cache=ResultCache(8), clock=clock)
+    engine.submit(0, [feats_np[0]], deadline_ms=500)
+    clock.tick(1.0)                         # deadline lapsed while queued
+    comps = engine.run_until_idle()
+    assert not comps
+    drops = engine.pop_dropped()
+    assert [d.reason for d in drops] == ["expired"]
+    s = engine.stats()
+    assert s["cache_misses"] == 0 and s["cache_entries"] == 0
+
+
+def test_dropped_stream_request_gets_terminal_marker(setup):
+    """A streamed request that expires still gets ONE terminal line:
+    the drop response carries 'stream'/'final' so a client reading
+    chunks until the terminal can never hang on an evicted stream."""
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.serving.engine import Dropped
+
+    model, variables, feats_np = setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0)
+    out = io.StringIO()
+    server = CaptionServer(engine, Vocab({1: "w"}),
+                           lambda vid: [feats_np[0]], out=out)
+    server._respond_dropped(Dropped(
+        ("r", "v0"), "expired", "resident",
+        meta={"id": "r", "video_id": "v0", "stream": True}))
+    obj = json.loads(out.getvalue())
+    assert obj["error"] == "expired"
+    assert obj["stream"] is True and obj["final"] is True
+    # Non-streamed drops keep the historical shape.
+    out.truncate(0), out.seek(0)
+    server._respond_dropped(Dropped(
+        ("p", "v0"), "expired", "queued",
+        meta={"id": "p", "video_id": "v0"}))
+    assert "final" not in json.loads(out.getvalue())
+
+
+def test_shed_and_drain_reject_carry_stream_terminal(setup, monkeypatch):
+    """Every streamed request gets exactly ONE terminal line — also on
+    the shed and rejected_draining reject paths (SERVING.md)."""
+    from cst_captioning_tpu.data.vocab import Vocab
+
+    model, variables, feats_np = setup
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(1,), queue_limit=0)
+    out = io.StringIO()
+    server = CaptionServer(engine, Vocab({1: "w"}),
+                           lambda vid: [feats_np[0]], out=out)
+    monkeypatch.setattr(engine, "submit",
+                        lambda *a, **k: False)          # force a shed
+    server._handle_line_inner(
+        json.dumps({"id": 7, "video_id": "v0", "op": "stream"}),
+        server._stdout_respond)
+    shed = json.loads(out.getvalue())
+    assert shed["error"] == "shed"
+    assert shed["stream"] is True and shed["final"] is True
+    monkeypatch.undo()
+    # Drain rejection of a queued streamed request: same terminal.
+    engine.submit(8, [feats_np[0]], stream=True,
+                  meta={"id": 8, "video_id": "v0", "stream": True})
+    out.truncate(0), out.seek(0)
+    server.handler = type("H", (), {"requested": True, "signal_count": 0})()
+    rc = server._drain_and_exit()
+    from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+
+    assert rc == EXIT_PREEMPTED
+    lines = [json.loads(l) for l in out.getvalue().splitlines()]
+    rej = [r for r in lines if r.get("error") == "rejected_draining"
+           and r["id"] == 8]
+    assert rej and rej[0]["stream"] is True and rej[0]["final"] is True
+
+
+def test_feature_fingerprint_exact():
+    a = [np.ones((3, 4), np.float32)]
+    b = [np.ones((3, 4), np.float32)]
+    assert feature_fingerprint(a) == feature_fingerprint(b)
+    b[0][0, 0] += 1e-7                        # any bit flip: new key
+    assert feature_fingerprint(a) != feature_fingerprint(b)
+
+
+# -- the serve_cache chaos drill -------------------------------------------
+
+
+def test_serve_cache_fault_grammar():
+    plan = FaultPlan.parse("serve_cache@req=2")
+    assert plan.fire("serve_cache", 2)
+    assert not plan.fire("serve_cache", 2)     # single-shot
+    with pytest.raises(ValueError):
+        FaultPlan.parse("serve_cache@step=2")  # wrong axis
+
+
+def test_serve_cache_chaos_drill_bit_identical(setup):
+    """serve_cache@req=N through the recovery plane: the injected lookup
+    failure is absorbed — counted, health degraded — and request N's
+    caption is bit-identical to the fault-free twin's."""
+    model, variables, feats_np = setup
+    registry = MetricsRegistry()
+    plan = FaultPlan.parse("serve_cache@req=2")
+    plan.bind_metrics(registry)        # scripts/serve.py's arming path
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           result_cache=ResultCache(8), fault_plan=plan,
+                           recover=True, registry=registry)
+    # req 0: decodes video 0 (miss).  req 1: hit.  req 2 (same video):
+    # the injected cache failure — must decode fresh, not die, not lose.
+    caps = {}
+    for rid in (0, 1, 2):
+        engine.submit(rid, [feats_np[0]])
+        for comp in engine.run_until_idle():
+            caps[comp.request_id] = comp
+    s = engine.stats()
+    assert s["cache_hits"] == 1 and s["cache_errors"] == 1
+    np.testing.assert_array_equal(caps[2].tokens, caps[0].tokens)
+    np.testing.assert_array_equal(caps[1].tokens, caps[0].tokens)
+    assert not caps[2].cache_hit               # decoded fresh
+    assert engine.health()["status"] == "degraded"
+    snap = registry.snapshot()["counters"]
+    assert snap["serve_cache_errors"] == 1
+    assert snap["fault_serve_cache"] == 1      # the plan counted its shot
+
+
+def test_stream_prefix_consistent_across_engine_rebuild(setup):
+    """A rebuild's deterministic replay re-derives already-streamed
+    tokens but must RE-EMIT none of them (the streamed watermark only
+    moves forward): request 0 streams its first chunk, then request 1's
+    injected wedge escalates straight to a rebuild (retry_limit=0), and
+    after the replay the concatenated chunks still equal the final
+    caption bit for bit.  Regression: _caption_so_far once prepended
+    res.prefix to the replayed toks, double-counting the pre-rebuild
+    tokens."""
+    model, variables, feats_np = setup
+    offline, _ = sample_captions(model, variables, [jnp.asarray(feats_np)],
+                                 jax.random.PRNGKey(0), MAX_LEN, greedy=True)
+    plan = FaultPlan.parse("serve_wedge@req=1")
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,), queue_limit=0,
+                           fault_plan=plan, recover=True, retry_limit=0,
+                           rebuild_limit=2)
+    # Row 1 runs the full MAX_LEN (the fixture's mild EOS bias only
+    # terminates row 0 early) — several chunks stream before the fault.
+    engine.submit(0, [feats_np[1]], stream=True)
+    comps, chunks = [], []
+    comps.extend(engine.step())              # chunk 1: tokens streamed
+    chunks.extend(engine.pop_stream_chunks())
+    assert chunks, "drill is degenerate: nothing streamed before rebuild"
+    engine.submit(1, [feats_np[2]], stream=True)   # wedge fires resident
+    while not engine.idle:
+        comps.extend(engine.step())
+        chunks.extend(engine.pop_stream_chunks())
+    s = engine.stats()
+    assert s["rebuilds"] == 1 and s["replay_divergence"] == 0
+    by_id = {c.request_id: c for c in comps}
+    np.testing.assert_array_equal(by_id[0].tokens, np.asarray(offline)[1])
+    for rid in (0, 1):
+        mine = sorted((c for c in chunks if c.request_id == rid),
+                      key=lambda c: c.seq)
+        cat = (np.concatenate([c.tokens for c in mine]) if mine
+               else np.zeros((0,), np.int32))
+        np.testing.assert_array_equal(cat, _trim_eos(by_id[rid].tokens))
+
+
+# -- the zipfian Poisson probe (make serve-stream-bench's fast twin) -------
+
+
+def test_zipfian_mix_seeded_and_skewed():
+    a = zipfian_mix(64, 4, 1.1, seed=3)
+    np.testing.assert_array_equal(a, zipfian_mix(64, 4, 1.1, seed=3))
+    counts = np.bincount(a, minlength=4)
+    assert counts[0] > counts[3]               # rank 1 dominates rank 4
+    np.testing.assert_array_equal(zipfian_mix(6, 3, 0.0),
+                                  [0, 1, 2, 0, 1, 2])
+
+
+def test_probe_stream_cache_zipfian(setup):
+    model, variables, _ = setup
+    # rate 20/s: ~50ms between arrivals, so each video's miss twin
+    # completes (4 tiny chunks) before its first repeat arrives — the
+    # hit assertion below cannot race the decode.
+    out = serving_probe(model, variables, [(T, D)],
+                        num_requests=10, rate_hz=20.0, max_len=MAX_LEN,
+                        decode_chunk=2, bucket_sizes=(1, 2), seed=4,
+                        stream=True, cache_size=8, unique_videos=3,
+                        zipf_alpha=1.1)
+    assert out["completed"] == 10 and out["shed"] == 0
+    assert out["recompiles_after_warmup"] == 0
+    assert out["unique_videos"] == 3 and out["zipf_alpha"] == 1.1
+    st = out["stream"]
+    assert st["enabled"] and st["prefix_ok"] and st["chunks"] >= 1
+    assert st["ttft_p50_ms"] is not None
+    ca = out["cache"]
+    assert ca["enabled"] and ca["parity_ok"]
+    assert ca["hits"] >= 1                     # repeats hit after the twin
+    assert ca["hits"] + ca["misses"] == 10
+    assert ca["hit_rate"] == pytest.approx(ca["hits"] / 10)
+
+
+def test_probe_defaults_unchanged(setup):
+    """The historical probe surface (no stream, no cache, unique-per-
+    request mix) still reports the same fields with the floors off."""
+    model, variables, _ = setup
+    out = serving_probe(model, variables, [(T, D)],
+                        num_requests=6, rate_hz=50.0, max_len=MAX_LEN,
+                        decode_chunk=2, bucket_sizes=(1, 2), seed=4)
+    assert out["completed"] == 6
+    assert out["stream"] == {"enabled": False}
+    assert out["cache"] == {"enabled": False}
+    assert out["unique_videos"] == 6
+
+
+# -- serve_report: rows + the two new gates --------------------------------
+
+
+BASE_RECORD = {
+    "metric": "serve_captions_per_sec_per_chip", "value": 50.0,
+    "latency_p50_ms": 1.0, "latency_p99_ms": 2.0,
+    "completed": 8, "num_requests": 8, "shed": 0,
+    "recompiles_after_warmup": 0, "rebuild_recompiles": 0,
+    "platform": "cpu",
+    "stream": {"enabled": True, "chunks": 12, "ttft_p50_ms": 0.5,
+               "ttft_p99_ms": 1.5, "chunk_gap_p50_ms": 0.3,
+               "chunk_gap_p99_ms": 0.9, "prefix_ok": True},
+    "cache": {"enabled": True, "hits": 5, "misses": 3, "evictions": 0,
+              "bypass": 0, "errors": 0, "hit_rate": 0.625,
+              "parity_ok": True, "parity_mismatches": 0},
+    "cache_off_captions_per_sec": 30.0, "cache_speedup": 1.667,
+}
+
+
+def _run_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def test_serve_report_renders_stream_and_cache_rows(tmp_path):
+    proc = _run_report(BASE_RECORD, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "ttft p50 / p99" in proc.stdout
+    assert "inter-chunk gap" in proc.stdout
+    assert "62.5%" in proc.stdout              # cache hit rate
+    assert "cache-off twin" in proc.stdout
+    assert "parity_ok=True" in proc.stdout
+
+
+def test_serve_report_gates_on_cache_parity(tmp_path):
+    bad = {**BASE_RECORD,
+           "cache": {**BASE_RECORD["cache"], "parity_ok": False,
+                     "parity_mismatches": 2}}
+    proc = _run_report(bad, tmp_path)
+    assert proc.returncode == 1
+    assert "not bit-identical to their miss twin" in proc.stderr
+
+
+def test_serve_report_gates_on_cache_not_paying(tmp_path):
+    bad = {**BASE_RECORD, "cache_off_captions_per_sec": 60.0}
+    proc = _run_report(bad, tmp_path)
+    assert proc.returncode == 1
+    assert "did not beat its cache-off twin" in proc.stderr
+
+
+def test_serve_report_old_records_still_render(tmp_path):
+    """Pre-ISSUE-12 records (no stream/cache sections) keep working."""
+    old = {k: v for k, v in BASE_RECORD.items()
+           if k not in ("stream", "cache", "cache_off_captions_per_sec",
+                        "cache_speedup")}
+    proc = _run_report(old, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "ttft" not in proc.stdout
+
+
+# -- opts ------------------------------------------------------------------
+
+
+def test_serve_cache_flag_validation():
+    from cst_captioning_tpu.opts import parse_opts
+
+    assert parse_opts([]).serve_cache == 256   # shipped default: armed
+    assert parse_opts(["--serve_cache", "0"]).serve_cache == 0
+    with pytest.raises(SystemExit) as exc:
+        parse_opts(["--serve_cache", "-3"])
+    assert exc.value.code == 2

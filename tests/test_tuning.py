@@ -288,7 +288,7 @@ class TestSweep:
         assert full == sweep_mod.sweep_space(base, fast=False)
         # the full grid covers every axis value at least once
         kernels = {p["decode_kernel"] for p in full}
-        assert kernels == {"reference", "pallas"}
+        assert kernels == {"reference", "pallas", "bf16"}
         assert {p["device_rewards"] for p in full} == {0, 1}
         assert {p["scan_unroll"] for p in full} >= {1, 2}
         assert len({p["batch_size"] for p in full}) == 2
