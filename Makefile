@@ -34,7 +34,7 @@ TRAIN_COMMON = \
 .PHONY: test lint lint-json chaos xe wxe cst cst_scb cst_host eval bench \
         demo trace-demo scale_chain report collect chip_window tune \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
-        serve-chaos bf16-parity clean
+        serve-chaos serve-fleet-bench serve-fleet-chaos bf16-parity clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -230,6 +230,31 @@ bf16-parity:
 serve-chaos:
 	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
 	  $(PY) -m pytest tests/test_serving_resilience.py tests/test_locksan.py -q
+
+# Fleet probe (SERVING.md "Fleet"): the open-loop Poisson stream through
+# the health-aware router over 3 replicas with a mid-stream hard replica
+# kill/restart — caps/s/fleet + p99 under kill/restart in the JSON line;
+# the probe itself asserts zero post-warmup compiles fleet-wide
+# (including through the restart) and serve_report exits 1 unless every
+# fleet caption is bit-identical to the fault-free single-engine run.
+serve-fleet-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --stage serving --platform cpu --cache 0 \
+	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
+	  --serve_requests 24 --serve_rate 200 --replicas 3 \
+	  --serve_kill_replica 1 --probe_eos_bias -2 \
+	  > /tmp/cst_serve_fleet.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_fleet.json
+
+# Fleet chaos drills (SERVING.md "Fleet", RESILIENCE.md "@replica=K"):
+# replica-targeted serve_wedge/serve_garble/admit_err plans through the
+# router, the hard kill/restart with resident re-queue, draining
+# rotation, fleet-edge shed — every request answered, captions
+# bit-identical to the fault-free single-engine twin, zero post-warmup
+# compiles fleet-wide.  Includes the slow serve_fleet.py subprocess
+# drills tier-1 skips; the fast slice rides tier-1 sanitizer-armed.
+serve-fleet-chaos:
+	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
+	  $(PY) -m pytest tests/test_serving_fleet.py -q
 
 # -- zero-setup synthetic demo --------------------------------------------
 

@@ -481,6 +481,8 @@ def bench_serving(args) -> dict:
         cache_size=args.serve_cache,
         unique_videos=args.serve_unique,
         zipf_alpha=args.serve_zipf,
+        replicas=args.replicas,
+        kill_replica=args.serve_kill_replica,
     )
     shapes = [(28, 2048), (1, 4096)]
     if args.serve_cache_compare and args.serve_cache:
@@ -585,6 +587,23 @@ def parse_args():
                    help="--stage serving: distinct videos in the request "
                         "mix (default: one per request — no repeats, the "
                         "historical probe)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="--stage serving: engine replicas behind the "
+                        "fleet router (serving/fleet.py).  > 1 drives "
+                        "the SAME seeded Poisson stream through the "
+                        "health-aware router over N replicas sharing one "
+                        "ProgramCache, reports caps/s/fleet, and runs a "
+                        "fault-free single-engine reference decode whose "
+                        "captions every fleet caption must match bit for "
+                        "bit (serve_report gates on it).  1 = the "
+                        "historical single-engine probe")
+    p.add_argument("--serve_kill_replica", type=int, default=-1,
+                   help="--stage serving with --replicas N: hard-kill "
+                        "this replica once half the request stream is "
+                        "submitted (its residents re-queue, the replica "
+                        "restarts warm from the shared ProgramCache) — "
+                        "the caps/s-under-replica-kill/restart drill.  "
+                        "-1 = no kill")
     p.add_argument("--serve_cache_compare", type=int, default=0,
                    help="--stage serving: 1 = also run the cache-OFF twin "
                         "at the same seed in the same bench run and "
@@ -682,6 +701,11 @@ def resolved_config(args) -> dict:
         # rehearsal before the measured probe), so records from the two
         # modes are not comparable and must not share a cache entry.
         config["serve_cache_compare"] = args.serve_cache_compare
+        # Fleet axes: a caps/s/fleet number over N replicas (and one
+        # measured through a mid-stream replica kill) must never share
+        # a cache entry with a single-engine record.
+        config["replicas"] = args.replicas
+        config["serve_kill_replica"] = args.serve_kill_replica
     return config
 
 

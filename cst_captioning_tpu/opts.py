@@ -442,6 +442,27 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "change invalidates correctly (SERVING.md "
                         "'Streaming & result cache').  Bounded LRU; 0 = "
                         "disabled.  Env fallback: CST_SERVE_CACHE")
+    g.add_argument("--serve_replicas",
+                   type=_positive_int(
+                       "--serve_replicas (or CST_SERVE_REPLICAS)"),
+                   default=os.environ.get("CST_SERVE_REPLICAS") or 2,
+                   help="scripts/serve_fleet.py: engine replicas behind "
+                        "the health-aware fleet router (serving/"
+                        "fleet.py) — per-device where devices exist, "
+                        "in-process otherwise; one shared ProgramCache "
+                        "and result cache across all of them (SERVING.md "
+                        "'Fleet').  Env fallback: CST_SERVE_REPLICAS")
+    g.add_argument("--serve_restart_limit",
+                   type=_nonneg_int("--serve_restart_limit",
+                                    "one strike: a replica's first "
+                                    "unplanned restart removes it"),
+                   default=3,
+                   help="unplanned supervised restarts (in-process exit "
+                        "124 or hard kill) each fleet replica may spend "
+                        "before it is removed from service; when every "
+                        "replica is out, the fleet front end exits 124 "
+                        "for whole-process supervised restart (SERVING.md "
+                        "'Fleet').  Planned rotations are free")
     g.add_argument("--serve_heartbeat_file", default=None,
                    help="scripts/serve.py: write a liveness "
                         "heartbeat.json here (watchdog discipline: "

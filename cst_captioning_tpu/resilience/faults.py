@@ -18,6 +18,10 @@ Grammar (comma-separated specs)::
     kind@batch=N       fire once when the loader assembles batch N (0-based)
     kind@req=N         fire once for the serving engine's Nth submitted
                        request (0-based submission ordinal)
+    kind@replica=K     fleet serving only: fire once inside replica K's
+                       engine, at that engine's first opportunity for the
+                       kind (serving kinds only; the router materializes
+                       it via :meth:`FaultPlan.for_replica`)
     kind@step=N*K      fire on steps N, N+1, ..., N+K-1 (K consecutive)
 
 Registered kinds and the index they key on:
@@ -87,8 +91,19 @@ KINDS: Dict[str, str] = {
     "serve_cache": "req",
 }
 
+#: Serving kinds that may ALTERNATIVELY target a fleet replica
+#: (``kind@replica=K``).  The router splits such a plan per replica
+#: (:meth:`FaultPlan.for_replica`); inside replica K's engine the spec
+#: fires at the first index probed for that kind — single-shot, like
+#: every other spec (RESILIENCE.md "Serving faults").
+REPLICA_KINDS = frozenset(k for k, axis in KINDS.items() if axis == "req")
+
+#: Sentinel ``FaultSpec.at``: the spec covers ANY index (used by the
+#: per-replica plans ``for_replica`` derives from ``@replica=K`` specs).
+ANY_INDEX = -1
+
 _SPEC_RE = re.compile(
-    r"^(?P<kind>[a-z_]+)@(?P<axis>step|batch|req)=(?P<at>\d+)"
+    r"^(?P<kind>[a-z_]+)@(?P<axis>step|batch|req|replica)=(?P<at>\d+)"
     r"(\*(?P<times>\d+))?$"
 )
 
@@ -99,19 +114,31 @@ class InjectedFault(OSError):
 
 @dataclass(frozen=True)
 class FaultSpec:
-    """One armed fault: ``kind`` fires at indices ``at .. at+times-1``."""
+    """One armed fault: ``kind`` fires at indices ``at .. at+times-1``.
+
+    ``replica`` is the fleet-targeting axis (``kind@replica=K``): the spec
+    is inert in the plan that parsed it and only acts once
+    :meth:`FaultPlan.for_replica` converts it into an any-index spec for
+    replica K's engine.  ``at == ANY_INDEX`` covers every index (single
+    shot — the consumed key is ``(kind, ANY_INDEX)``)."""
 
     kind: str
     at: int
     times: int = 1
+    replica: Optional[int] = None
 
     def covers(self, index: int) -> bool:
+        if self.at == ANY_INDEX:
+            return True
         return self.at <= index < self.at + self.times
 
     def __str__(self) -> str:
+        if self.replica is not None:
+            return f"{self.kind}@replica={self.replica}"
         axis = KINDS[self.kind]
         tail = f"*{self.times}" if self.times != 1 else ""
-        return f"{self.kind}@{axis}={self.at}{tail}"
+        at = "any" if self.at == ANY_INDEX else self.at
+        return f"{self.kind}@{axis}={at}{tail}"
 
 
 @dataclass
@@ -122,6 +149,8 @@ class FaultPlan:
     _consumed: Set[Tuple[str, int]] = field(default_factory=set)
     _state_path: Optional[str] = None
     _metrics: Optional[object] = field(default=None, repr=False)
+    _derived: Dict[int, Optional["FaultPlan"]] = \
+        field(default_factory=dict, repr=False)
 
     def bind_metrics(self, registry) -> "FaultPlan":
         """Count firings into a ``telemetry.MetricsRegistry``
@@ -172,6 +201,18 @@ class FaultPlan:
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; registered: {sorted(KINDS)}")
+            if axis == "replica":
+                if kind not in REPLICA_KINDS:
+                    raise ValueError(
+                        f"fault {kind!r} cannot target a fleet replica; "
+                        f"@replica=K is valid for {sorted(REPLICA_KINDS)}")
+                if m.group("times"):
+                    raise ValueError(
+                        f"bad fault spec {raw!r}: @replica=K takes no "
+                        "*K repeat (one firing per targeted replica)")
+                specs.append(FaultSpec(kind, ANY_INDEX,
+                                       replica=int(m.group("at"))))
+                continue
             if KINDS[kind] != axis:
                 raise ValueError(
                     f"fault {kind!r} keys on {KINDS[kind]!r}, not {axis!r}")
@@ -179,21 +220,57 @@ class FaultPlan:
                                    int(m.group("times") or 1)))
         return cls(specs=specs) if specs else None
 
+    def for_replica(self, replica: int) -> Optional["FaultPlan"]:
+        """The per-replica plan the fleet router hands replica
+        ``replica``'s engine: every ``kind@replica=K`` spec targeting this
+        replica becomes an any-index single-shot spec (it fires at the
+        engine's FIRST probe of that kind — deterministic, because the
+        router and engine are single-threaded per scheduler loop).
+        Specs on other axes are NOT forwarded: in fleet mode the ``@req``
+        ordinal is per-engine and therefore ambiguous, so replica drills
+        use ``@replica=K`` (RESILIENCE.md).  Returns None when nothing
+        targets this replica (the engine pays zero per-site checks).
+        Metrics binding is inherited; consumed state is per-derived-plan
+        (each targeted replica fires its own specs once).  MEMOIZED per
+        replica: a restarted replica's fresh engine receives the SAME
+        derived plan, so its consumed set survives the restart — the
+        single-shot-across-resumes discipline ``fire`` has for
+        rollbacks, without which a replica-targeted fault would re-fire
+        on every restart and burn the whole restart budget."""
+        k = int(replica)
+        if k in self._derived:
+            return self._derived[k]
+        specs = [FaultSpec(s.kind, ANY_INDEX) for s in self.specs
+                 if s.replica == k]
+        derived: Optional[FaultPlan] = None
+        if specs:
+            derived = FaultPlan(specs=specs)
+            derived._metrics = self._metrics
+        self._derived[k] = derived
+        return derived
+
     def fire(self, kind: str, index: int) -> bool:
         """True exactly once per (kind, index) covered by a spec.  The
-        consumed set makes replays after rollback/resume fault-free."""
-        key = (kind, int(index))
-        if key in self._consumed:
-            return False
+        consumed set makes replays after rollback/resume fault-free.
+        Replica-targeted specs never fire from the plan that parsed them
+        (only from a ``for_replica`` derivative, where they cover any
+        index and consume the ``ANY_INDEX`` key)."""
         for spec in self.specs:
-            if spec.kind == kind and spec.covers(index):
+            if spec.kind == kind and spec.replica is None \
+                    and spec.covers(index):
+                key = (kind, ANY_INDEX if spec.at == ANY_INDEX
+                       else int(index))
+                if key in self._consumed:
+                    return False
                 self._consumed.add(key)
                 if self._state_path is not None:
                     # Record BEFORE the fault acts: a wedge kills the
                     # process, and the resume attempt must see it spent.
                     try:
                         with open(self._state_path, "a") as f:
-                            f.write(json.dumps([kind, int(index)]) + "\n")
+                            # The CONSUMED key (ANY_INDEX for any-index
+                            # specs), so a reload blocks the same spec.
+                            f.write(json.dumps([kind, key[1]]) + "\n")
                             f.flush()
                             os.fsync(f.fileno())
                     except OSError:

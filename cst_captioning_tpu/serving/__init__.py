@@ -23,7 +23,12 @@ discipline of PAPERS.md arXiv 2603.09555):
   preemption/exit-code taxonomy;
 - ``bench.py``    — the open-loop Poisson serving probe (seeded,
   deterministic arrivals; p50/p99 latency + captions/s) that joins the
-  repo bench's JSON line and cache.
+  repo bench's JSON line and cache;
+- ``fleet.py``    — the health-aware router over N supervised engine
+  replicas (shared ProgramCache/result cache, route-around-degraded,
+  draining rotation, supervised replica restart with resident re-queue,
+  fleet-edge deadline shed) speaking the engine's scheduler surface so
+  ``server.py`` drives a fleet unchanged (``scripts/serve_fleet.py``).
 
 Architecture, bucket policy, and the drain contract: SERVING.md.
 """
@@ -35,7 +40,9 @@ from .buckets import DEFAULT_BUCKETS, ProgramCache, parse_buckets  # noqa: F401
 # parse time, which must not drag a jax init into every CLI parse.
 _LAZY = {"Completion": ".engine", "Request": ".engine",
          "ServingEngine": ".engine", "serve_decode_split": ".engine",
-         "CaptionServer": ".server", "serving_probe": ".bench"}
+         "CaptionServer": ".server", "serving_probe": ".bench",
+         "FleetRouter": ".fleet", "FleetUnrecoverable": ".fleet",
+         "FLEET_COUNTERS": ".fleet"}
 
 
 def __getattr__(name):

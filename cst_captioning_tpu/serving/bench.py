@@ -26,6 +26,17 @@ Latency-floor extensions (SERVING.md "Streaming & result cache"):
   final caption — a violation raises, failing the bench), and reports
   time-to-first-token and inter-chunk-gap percentiles beside p50/p99.
 
+Fleet extension (SERVING.md "Fleet"): ``replicas > 1`` drives the SAME
+seeded request stream through a :class:`fleet.FleetRouter` over N
+engine replicas sharing one ProgramCache (and one result cache when
+armed); ``kill_replica >= 0`` hard-kills that replica once half the
+stream is in — the probe then proves the PR-9 bar FLEET-WIDE: every
+request answered, zero program builds after warmup including through
+the replica restart, and every caption bit-identical to a fault-free
+single-engine decode of the same videos (the reference run at the end;
+``scripts/serve_report.py`` exits 1 on a parity or recompile
+violation).  The headline captions/s is caps/s/fleet by construction.
+
 Determinism: the arrival schedule, per-video features, and the zipfian
 mix are seeded, so two runs issue the identical request stream; the
 measured latencies are wall-clock (that is the point).  The repo bench
@@ -40,7 +51,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
-from .buckets import DEFAULT_BUCKETS
+from .buckets import DEFAULT_BUCKETS, ProgramCache
 from .cache import ResultCache
 from .engine import ServingEngine, _trim_eos
 
@@ -76,6 +87,7 @@ def serving_probe(model, variables, feat_shapes: Sequence,
                   stream: bool = False, cache_size: int = 0,
                   unique_videos: Optional[int] = None,
                   zipf_alpha: float = 0.0,
+                  replicas: int = 1, kill_replica: int = -1,
                   registry=None, tracer=None,
                   clock=time.perf_counter) -> Dict[str, Any]:
     """Drive one engine through a seeded Poisson load; -> metrics dict.
@@ -97,13 +109,28 @@ def serving_probe(model, variables, feat_shapes: Sequence,
     ]
     video_of = zipfian_mix(n, uniq, zipf_alpha, seed + 2)
     cache = ResultCache(int(cache_size)) if cache_size else None
-    engine = ServingEngine(
-        model, variables, feat_shapes, max_len=max_len,
-        beam_size=beam_size, length_norm=length_norm,
-        decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
-        queue_limit=queue_limit, result_cache=cache,
-        registry=registry, tracer=tracer, clock=clock)
+    fleet_n = max(1, int(replicas))
+    programs = ProgramCache(registry)   # shared across replicas/restarts
+
+    def build_engine(_k=0):
+        return ServingEngine(
+            model, variables, feat_shapes, max_len=max_len,
+            beam_size=beam_size, length_norm=length_norm,
+            decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
+            queue_limit=queue_limit, result_cache=cache,
+            program_cache=programs,
+            registry=registry, tracer=tracer, clock=clock)
+
+    if fleet_n > 1:
+        from .fleet import FleetRouter
+
+        engine = FleetRouter(build_engine, fleet_n,
+                             registry=registry, clock=clock)
+    else:
+        engine = build_engine()
     warm_builds = engine.warm()["compiles"]
+    kill_at = (n // 2 if fleet_n > 1 and kill_replica >= 0 else None)
+    killed = False
 
     t0 = clock()
     submitted = 0
@@ -112,20 +139,25 @@ def serving_probe(model, variables, feat_shapes: Sequence,
     hit: Dict[Any, bool] = {}
     chunks: Dict[Any, list] = {}
     shed = 0
+    dropped = 0
 
     def harvest(comps):
-        nonlocal shed
+        nonlocal shed, dropped
         for comp in comps:
             # Latency from the SCHEDULED arrival (open-loop convention).
             latencies[comp.request_id] = (
                 (comp.done_at - t0) - arrivals[comp.request_id])
             tokens[comp.request_id] = np.asarray(comp.tokens)
             hit[comp.request_id] = bool(comp.cache_hit)
+        # A drop record is an ANSWER (expired / shed / admit-failed);
+        # a fault-free probe sees zero, but the loop must terminate on
+        # them (the fleet kill drill's worst case), never spin.
+        dropped += len(engine.pop_dropped())
         if stream:
             for ch in engine.pop_stream_chunks():
                 chunks.setdefault(ch.request_id, []).append(ch)
 
-    while len(latencies) + shed < n:
+    while len(latencies) + shed + dropped < n:
         now = clock() - t0
         while submitted < n and arrivals[submitted] <= now:
             if not engine.submit(submitted,
@@ -133,6 +165,12 @@ def serving_probe(model, variables, feat_shapes: Sequence,
                                  stream=stream):
                 shed += 1
             submitted += 1
+        if kill_at is not None and not killed and submitted >= kill_at:
+            # The hard kill/restart drill: one replica dies mid-flight
+            # with residents aboard; its requests re-queue and the
+            # restarted replica re-warms from the shared ProgramCache.
+            engine.kill_replica(int(kill_replica) % fleet_n)
+            killed = True
         harvest(engine.step())
         if engine.idle and submitted < n:
             time.sleep(min(max(arrivals[submitted] - (clock() - t0), 0.0),
@@ -200,6 +238,46 @@ def serving_probe(model, variables, feat_shapes: Sequence,
             "parity_mismatches": mismatches,
         })
 
+    fleet_out: Dict[str, Any] = {"enabled": fleet_n > 1}
+    if fleet_n > 1:
+        # The fleet acceptance record (SERVING.md "Fleet"): every
+        # caption bit-identical to a fault-free SINGLE-ENGINE decode of
+        # the same videos.  The reference engine shares the ProgramCache
+        # (same config identity -> zero builds, asserted below) but
+        # never the result cache (a hit would skip the reference
+        # decode and prove nothing).
+        ref_engine = ServingEngine(
+            model, variables, feat_shapes, max_len=max_len,
+            beam_size=beam_size, length_norm=length_norm,
+            decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
+            queue_limit=0, program_cache=programs, clock=clock)
+        for v in range(uniq):
+            ref_engine.submit(("ref", v), feats[v])
+        ref: Dict[int, np.ndarray] = {}
+        for comp in ref_engine.run_until_idle():
+            ref[int(comp.request_id[1])] = np.asarray(comp.tokens)
+        mismatches = sum(
+            1 for rid, row in tokens.items()
+            if not np.array_equal(row, ref.get(int(video_of[rid]))))
+        ref_builds = programs.builds - warm_builds
+        if ref_builds != 0:
+            raise RuntimeError(
+                f"the fault-free reference engine compiled {ref_builds} "
+                "program(s) through the shared fleet ProgramCache — the "
+                "config identity is broken (SERVING.md 'Fleet')")
+        st = engine.stats()
+        fleet_out.update({
+            "replicas": fleet_n,
+            **st["fleet"],
+            "killed_replica": (int(kill_replica) % fleet_n if killed
+                               else None),
+            "answered": len(latencies) + shed + dropped,
+            "dropped": dropped,
+            "parity_ok": mismatches == 0,
+            "parity_mismatches": mismatches,
+            "per_replica": st["per_replica"],
+        })
+
     lat_ms = np.asarray(sorted(latencies.values())) * 1e3
     pct = (lambda q: round(float(np.percentile(lat_ms, q)), 3)
            if lat_ms.size else None)
@@ -212,6 +290,7 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "num_requests": n,
         "completed": len(latencies),
         "shed": shed,
+        "dropped": dropped,
         "rate_hz": float(rate_hz),
         "arrival_seed": int(seed),
         "unique_videos": uniq,
@@ -227,6 +306,10 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "max_len": int(max_len),
         "stream": stream_out,
         "cache": cache_out,
+        # Fleet record (serve_report renders per-replica rows and gates
+        # on parity_ok; absent/disabled on single-engine probes so old
+        # records keep their exact shape).
+        **({"fleet": fleet_out} if fleet_n > 1 else {}),
         # Fault-tolerance audit (all 0 on a healthy fault-free probe;
         # scripts/serve_report.py renders them and FAILS on a
         # rebuild-recompile violation — RESILIENCE.md "Serving faults").

@@ -152,6 +152,9 @@ class Request:
     admit_attempts: int = 0
     #: Emit per-chunk StreamChunk records ({"op": "stream"} traffic).
     stream: bool = False
+    #: The request's explicit per-request cache bypass — kept on the
+    #: request so a fleet requeue honors it on the new engine too.
+    no_cache: bool = False
     #: Result-cache write-back key (None = bypassed / cache disabled /
     #: lookup faulted); set at submit, consumed at harvest.
     cache_key: Optional[tuple] = None
@@ -272,6 +275,7 @@ class ServingEngine:
                  step_budget_ms: float = 0.0,
                  degraded_window_s: float = 60.0,
                  result_cache: Optional[ResultCache] = None,
+                 program_cache: Optional[ProgramCache] = None,
                  registry=None, tracer=None,
                  clock: Callable[[], float] = time.monotonic):
         if getattr(model, "decoder_type", "lstm") != "lstm":
@@ -307,7 +311,14 @@ class ServingEngine:
         self._tracer = tracer
         self.clock = clock
 
-        self._cache = ProgramCache(registry)
+        # ``program_cache`` may be SHARED across engines (the fleet
+        # router's replicas, and a replica's restarted engine): keys
+        # carry the full configuration identity, so same-config engines
+        # reuse each other's programs — a replica restart re-warms with
+        # ZERO new builds (SERVING.md "Fleet").  Explicit None check: a
+        # fresh shared cache is empty and __len__-falsy.
+        self._cache = (ProgramCache(registry) if program_cache is None
+                       else program_cache)
         # Single-owner scheduler state (the module-docstring threading
         # contract): if this file ever grows a thread whose target
         # touches these, cstlint:thread-ownership fires.
@@ -656,6 +667,7 @@ class ServingEngine:
                                    arrival=arrival, meta=meta,
                                    index=index, deadline=deadline,
                                    stream=bool(stream),
+                                   no_cache=bool(no_cache),
                                    cache_key=cache_key))
         self._update_gauges()
         return True
@@ -718,6 +730,93 @@ class ServingEngine:
         a ``"stream": true`` JSONL line BEFORE the final response."""
         out, self._stream_chunks = self._stream_chunks, []
         return out
+
+    # -- fleet surface (serving/fleet.py) ----------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def min_service_s(self) -> Optional[float]:
+        """This engine's shed floor (one p99 chunk; None until the
+        window is honest) — the fleet router reads every replica's floor
+        for the fleet-edge "provably unmeetable everywhere" shed."""
+        return self._min_service_s()
+
+    def degraded(self) -> bool:
+        """Cheap health-tier read (the boolean behind ``health()``'s
+        ``degraded``) for the router's per-submit candidate ranking —
+        no counter dicts built on the routing hot path."""
+        return (self._last_recovery_at is not None
+                and (self.clock() - self._last_recovery_at)
+                < self.degraded_window_s)
+
+    def latency_window_s(self) -> List[float]:
+        """Raw end-to-end latencies (seconds) in the retained window;
+        the fleet router concatenates replicas' windows so fleet p50/p99
+        are computed over samples, never averaged percentiles."""
+        return list(self._latencies)
+
+    def stream_windows_s(self) -> Tuple[List[float], List[float]]:
+        """Raw (TTFT, inter-chunk-gap) second windows — same
+        fleet-aggregation contract as ``latency_window_s``."""
+        return list(self._ttft), list(self._gaps)
+
+    def evacuate(self, include_residents: bool = True
+                 ) -> Tuple[List[Completion], List[Request]]:
+        """Strip this engine of everything it still owes: pending
+        cache-hit completions (already finished — returned for the
+        caller's response flow) and the queued requests, plus the
+        resident ones when ``include_residents`` (returned for
+        re-routing; their device rows are abandoned — the re-decode on
+        another engine is the same deterministic program on the same
+        inputs, so the caption is unchanged).  The fleet router calls
+        this with residents on a replica it kills/restarts, and without
+        on one it rotates (residents finish in place, queued work moves
+        so it never waits out the rotation)."""
+        done = list(self._hits)
+        self._hits.clear()
+        reqs: List[Request] = list(self._queue)
+        self._queue.clear()
+        if include_residents:
+            for slot, res in enumerate(self._residents):
+                if res is not None:
+                    reqs.append(res.request)
+                    self._residents[slot] = None
+        self._update_gauges()
+        return done, reqs
+
+    def requeue(self, req: Request) -> bool:
+        """Adopt a request evacuated from another engine (the fleet
+        restart/rotation path): re-enters this engine's admission queue
+        as a fresh local submission (new ``@req`` ordinal — per-engine
+        fault plans key on local ordinals) while PRESERVING the original
+        arrival clock, so the request's latency keeps counting from its
+        first submission, and the remaining absolute deadline (an
+        already-lapsed one expires at admission instead of silently
+        losing its TTL)."""
+        if req.deadline is not None:
+            remaining_ms = max((req.deadline - self.clock()) * 1e3, 1e-3)
+        else:
+            remaining_ms = 0.0
+        ok = self.submit(req.request_id, req.feats, meta=req.meta,
+                         deadline_ms=remaining_ms, stream=req.stream,
+                         no_cache=req.no_cache)
+        if ok:
+            if self._queue and \
+                    self._queue[-1].request_id == req.request_id:
+                self._queue[-1].arrival = req.arrival
+            elif self._hits and \
+                    self._hits[-1].request_id == req.request_id:
+                # The re-submission completed instantly as a shared-
+                # cache hit: restore the ORIGINAL arrival there too, so
+                # a request that waited through a replica restart never
+                # under-reports its latency.
+                hit = self._hits[-1]
+                hit.latency_s = hit.done_at - req.arrival
+                if self._latencies:
+                    self._latencies[-1] = hit.latency_s
+        return ok
 
     # -- deadlines ---------------------------------------------------------
 
@@ -1308,12 +1407,9 @@ class ServingEngine:
         within ``degraded_window_s``) plus queue depth and the recovery
         counters.  Host state only: safe to call from the watchdog's
         heartbeat payload while the scheduler may be wedged."""
-        now = self.clock()
-        recovering = (self._last_recovery_at is not None
-                      and (now - self._last_recovery_at)
-                      < self.degraded_window_s)
         return {
-            "status": health_status(draining=False, recovering=recovering),
+            "status": health_status(draining=False,
+                                    recovering=self.degraded()),
             "queue_depth": len(self._queue),
             "residents": self.resident_count,
             "slots": self._slots_n,

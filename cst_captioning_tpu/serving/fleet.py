@@ -1,0 +1,701 @@
+"""Health-aware fleet router over N self-healing serving engines.
+
+One process used to own exactly one :class:`ServingEngine` on one
+device.  The fleet layer keeps that engine exactly as it is — compiled
+programs, continuous batching, the PR-9 self-healing ladder — and adds
+the piece "millions of users" needs: a router that spreads JSONL
+requests across N supervised engine REPLICAS (in-process, one engine
+per replica; per-device via ``jax.default_device`` where devices
+exist), consuming the health/exit taxonomy the single-engine plane
+already speaks:
+
+- **Routing policy** (``submit``): candidate replicas are the
+  in-service ones (not draining, not dead), ranked healthy-first then
+  least-loaded — the router ROUTES AROUND ``degraded`` replicas (a
+  replica inside its recovery window only receives work when no ``ok``
+  replica can take it) and never routes to a ``draining`` one.  A
+  replica whose bounded queue sheds is skipped for the next candidate
+  (``fleet_rerouted``); only when EVERY candidate sheds does the fleet
+  shed (``fleet_shed``).
+- **Fleet-edge deadline shed**: a request whose TTL cannot cover even
+  one p99 decode chunk at ANY replica (every candidate's
+  ``min_service_s`` floor is known and above the TTL) is shed at the
+  fleet edge — ``Dropped(reason="deadline_shed", where="fleet")`` —
+  before it ever queues at a replica and wastes decode steps there.
+- **Replica lifecycle** (the supervised-restart contract): an engine
+  that exhausts its own recovery ladder raises
+  :class:`ServingUnrecoverable` — the in-process equivalent of exit 124
+  in the taxonomy — and the router treats it exactly as a supervisor
+  treats 124: restart the replica (fresh engine through the SHARED
+  :class:`buckets.ProgramCache`, so the re-warm compiles NOTHING) and
+  re-queue its residents onto the other replicas (``requeue`` preserves
+  arrival clocks and deadlines; the re-decode is the same deterministic
+  program on the same inputs, so captions stay bit-identical to a
+  fault-free run).  ``kill_replica`` is the chaos drill's hard kill —
+  same path, counted separately.  A replica that exhausts
+  ``restart_limit`` is removed from service (``dead``); when no replica
+  is left, :class:`FleetUnrecoverable` maps onto exit 124 at the fleet
+  front end — the whole-process supervised restart.
+- **Draining rotation** (``rotate``): mark a replica ``draining`` — the
+  router stops routing to it and moves its queued-but-unadmitted work
+  to live replicas immediately — let its residents finish, then rebuild
+  its engine warm from the shared ProgramCache and return it to
+  service.  A rolling engine rebuild that never stalls the fleet.
+- **Shared result cache**: every replica is built over ONE
+  :class:`cache.ResultCache` (serving/cache.py is engine-shareable by
+  design), so a caption decoded at replica 0 is a hit at replica 3.
+- **Health snapshots**: the scheduler refreshes a per-replica snapshot
+  table after every step under ``named_lock("serving.fleet.health")``;
+  ``health()`` (safe from the watchdog/heartbeat thread) renders the
+  fleet view from those snapshots — worst-of-replicas status plus
+  per-replica detail — without ever touching an engine off-thread.
+
+The router SPEAKS THE ENGINE'S SCHEDULER SURFACE (``submit`` / ``step``
+/ ``drain`` / ``pop_dropped`` / ``pop_stream_chunks`` / ``stats`` /
+``health`` / ``idle`` ...), so :class:`serving.server.CaptionServer`
+drives a fleet exactly like one engine — same JSONL wire format, same
+drain contract, zero front-end forks (``scripts/serve_fleet.py``).
+
+Streaming across a restart: the router keeps per-request fleet-level
+watermarks (``_stream_sent`` / ``_stream_cur``): a killed replica's
+request re-decodes from step 0 on its new owner, and the re-derived
+tokens fall inside the watermark and are filtered — the engine-rebuild
+replay discipline lifted one level, so a streaming client never sees a
+duplicate token and the concatenated chunks stay prefix-consistent.
+
+Threading: the router is single-owner like the engine — ``submit`` /
+``step`` / ``drain`` / ``rotate`` / ``kill_replica`` run on the
+server's scheduler loop thread (the ``owned_by=scheduler`` state
+below); only the snapshot table is shared with the watchdog thread,
+under the declared ``serving.fleet.health`` lock (a LEAF toward the
+registry, per LOCK_ORDER).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from ..utils.locksan import declare_order, named_lock
+from .engine import (Completion, Dropped, Request, ServingEngine,
+                     ServingUnrecoverable, StreamChunk)
+
+log = logging.getLogger("cst_captioning_tpu.serving.fleet")
+
+#: Fleet-level counters (declared at 0 — registry.declare; SERVING.md
+#: "Fleet" pins this table the way engine.COUNTERS is pinned).
+FLEET_COUNTERS = ("fleet_routed", "fleet_rerouted", "fleet_shed",
+                  "fleet_replica_restarts", "fleet_replica_kills")
+
+#: Declared acquisition order (cstlint:lock-order + the runtime
+#: sanitizer): the snapshot lock may be held while the registry's leaf
+#: lock is taken (a snapshot refresh that also bumps a counter), never
+#: the reverse — the registry stays a project-wide leaf.
+LOCK_ORDER = ("serving.fleet.health", "telemetry.registry")
+declare_order(*LOCK_ORDER)
+
+#: Worst-of ordering for the fleet health status (SERVING.md "Fleet"):
+#: a rotating replica makes the honest worst-of view ``draining``; the
+#: per-replica detail disambiguates.  ``dead`` replicas rank as
+#: ``degraded`` fleet-wide (capacity lost, the survivors still serve).
+_STATUS_RANK = {"ok": 0, "degraded": 1, "draining": 2}
+
+
+class FleetUnrecoverable(RuntimeError):
+    """Every replica is out of service and the restart budget is spent:
+    in-process supervision is exhausted.  The fleet front end maps this
+    onto ``exitcodes.EXIT_WEDGE`` (124) — the same supervised-restart
+    signal a single engine's :class:`ServingUnrecoverable` carries."""
+
+
+class Replica:
+    """One supervised engine replica: the engine plus its lifecycle
+    bookkeeping (draining flag, restart/kill counts, completed-total
+    across engine generations).  ``device`` (optional) pins every engine
+    call under ``jax.default_device`` so per-device replicas place their
+    state and programs without any engine change."""
+
+    def __init__(self, index: int, factory: Callable[[int], ServingEngine],
+                 device=None):
+        self.index = int(index)
+        self.device = device
+        self._factory = factory
+        self.engine: Optional[ServingEngine] = None
+        self.draining = False
+        self.dead = False
+        self.restarts = 0
+        self.kills = 0
+        #: Completions harvested by engines this replica has since
+        #: retired (restart/rotation) — per-replica lifetime totals.
+        self.completed_prior = 0
+
+    def on_device(self):
+        if self.device is None:
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
+
+    def start(self, warm: bool = False) -> None:
+        with self.on_device():
+            self.engine = self._factory(self.index)
+            if warm:
+                self.engine.warm()
+
+    @property
+    def in_service(self) -> bool:
+        return self.engine is not None and not self.draining \
+            and not self.dead
+
+    def completed_total(self) -> int:
+        live = (self.engine.health()["completed"]
+                if self.engine is not None else 0)
+        return self.completed_prior + live
+
+
+class FleetRouter:
+    """Route requests across N supervised :class:`Replica` instances.
+
+    ``engine_factory(replica_index) -> ServingEngine`` builds one
+    replica's engine; the caller bakes the SHARED ``ProgramCache`` /
+    ``ResultCache`` and any per-replica fault plan
+    (``FaultPlan.for_replica``) into the factory, and the router keeps
+    it so a restarted replica rebuilds the same way.  ``devices`` (a
+    sequence of jax devices, optional) is assigned round-robin;
+    ``restart_limit`` bounds UNPLANNED restarts per replica (rotations
+    are maintenance and do not burn it).  All engines must share one
+    configuration (the router reports replica 0's geometry as its own).
+    """
+
+    def __init__(self, engine_factory: Callable[[int], ServingEngine],
+                 replicas: int, *, devices: Optional[Sequence] = None,
+                 restart_limit: int = 3, registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        n = int(replicas)
+        if n < 1:
+            raise ValueError(f"a fleet needs >= 1 replica, got {n}")
+        devs = ([None] * n if not devices
+                else [devices[k % len(devices)] for k in range(n)])
+        self.restart_limit = max(0, int(restart_limit))
+        self._registry = registry
+        self.clock = clock
+        # Single-owner scheduler state (the module-docstring contract).
+        self._replicas: List[Replica] = [  # cstlint: owned_by=scheduler
+            Replica(k, engine_factory, devs[k]) for k in range(n)]
+        self._dropped: List[Dropped] = []  # cstlint: owned_by=scheduler
+        self._stream_chunks: List[StreamChunk] = []  # cstlint: owned_by=scheduler
+        self._evac_done: List[Completion] = []  # cstlint: owned_by=scheduler
+        # Fleet stream watermarks: tokens already SENT per request vs
+        # tokens emitted by the request's CURRENT owning engine.
+        self._stream_sent: Dict[Any, int] = {}  # cstlint: owned_by=scheduler
+        self._stream_cur: Dict[Any, int] = {}  # cstlint: owned_by=scheduler
+        self._stream_seq: Dict[Any, int] = {}  # cstlint: owned_by=scheduler
+        self._routed = 0
+        self._rerouted = 0
+        self._fleet_shed = 0
+        self._restarts = 0
+        self._kills = 0
+        self._health_lock = named_lock("serving.fleet.health")
+        self._snapshots: List[Dict[str, Any]] = []  # cstlint: guarded_by=self._health_lock
+        if registry is not None:
+            registry.declare(*FLEET_COUNTERS)
+        for rep in self._replicas:
+            rep.start()
+        first = self._replicas[0].engine
+        # Fleet-wide config view (shared by construction; the server's
+        # stream-degeneracy warn and the fleet-edge shed read these).
+        self.chunk = first.chunk
+        self.max_len = first.max_len
+        self.beam_size = first.beam_size
+        self.buckets = first.buckets
+        self.deadline_ms = first.deadline_ms
+        self._update_snapshots()
+
+    # -- routing -----------------------------------------------------------
+
+    def _candidates(self) -> List[Replica]:
+        """In-service replicas, healthy tier first, least-loaded within
+        a tier (queue + residents), index as the deterministic tiebreak."""
+        active = [r for r in self._replicas if r.in_service]
+
+        def key(rep: Replica):
+            # Cheap property reads, not engine.health() — this ranking
+            # runs once per routed request (cstlint HOT_PATHS).
+            eng = rep.engine
+            return (1 if eng.degraded() else 0,
+                    eng.queue_depth + eng.resident_count, rep.index)
+
+        return sorted(active, key=key)
+
+    def submit(self, request_id, feats, meta: Optional[dict] = None,
+               deadline_ms: Optional[float] = None, stream: bool = False,
+               no_cache: bool = False) -> bool:
+        """Route one request.  True = accepted somewhere (or answered at
+        the fleet edge via a drop record); False = every candidate's
+        bounded queue shed it — the fleet-wide backpressure signal."""
+        cands = self._candidates()
+        if not cands:
+            if any(r.in_service or r.draining for r in self._replicas):
+                # Momentarily no routable replica (e.g. the last live
+                # one is mid-rotation): SHED — the client's retry signal
+                # — never a process-level failure; the rotation will
+                # finish and service resumes.
+                self._fleet_shed += 1
+                self._inc("fleet_shed")
+                return False
+            raise FleetUnrecoverable(
+                "every replica is dead (per-replica restart budget "
+                f"{self.restart_limit} exhausted fleet-wide)")
+        # A fresh submission is a fresh stream: clear any watermark a
+        # previous request with this (client-chosen) id left behind, so
+        # a reused id is never silently filtered against stale state.
+        self._stream_forget(request_id)
+        ttl = (self.deadline_ms if deadline_ms is None
+               else float(deadline_ms))
+        if ttl and ttl > 0:
+            floors = [rep.engine.min_service_s() for rep in cands]
+            if all(f is not None for f in floors) \
+                    and ttl / 1e3 < min(floors):
+                # Provably unmeetable EVERYWHERE: shed at the edge, with
+                # an explicit answer — never a silent loss, never a
+                # queue slot wasted at a replica.
+                self._fleet_shed += 1
+                self._inc("fleet_shed")
+                self._dropped.append(Dropped(request_id, "deadline_shed",
+                                             "fleet", meta=meta))
+                return True
+        for i, rep in enumerate(cands):
+            with rep.on_device():
+                ok = rep.engine.submit(request_id, feats, meta=meta,
+                                       deadline_ms=deadline_ms,
+                                       stream=stream, no_cache=no_cache)
+            if ok:
+                self._routed += 1
+                self._inc("fleet_routed")
+                if i:
+                    self._rerouted += 1
+                    self._inc("fleet_rerouted")
+                return True
+        self._fleet_shed += 1
+        self._inc("fleet_shed")
+        return False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def kill_replica(self, index: int) -> None:
+        """Hard replica kill (the chaos drill's stand-in for a replica
+        process dying with exit 124): evacuate and re-queue everything
+        it owes, then restart it through the shared ProgramCache."""
+        rep = self._replicas[int(index)]
+        if rep.engine is None:
+            return
+        rep.kills += 1
+        self._kills += 1
+        self._inc("fleet_replica_kills")
+        log.warning("fleet: hard kill of replica %d (%d resident, "
+                    "%d queued)", rep.index, rep.engine.resident_count,
+                    rep.engine.queue_depth)
+        self._restart_replica(rep)
+
+    def rotate(self, index: int) -> None:
+        """Begin draining replica ``index`` for a rolling rebuild: the
+        router stops routing to it, queued-but-unadmitted work moves to
+        live replicas NOW (it must not wait out the rotation), residents
+        finish over the next steps, then ``step`` rebuilds the engine
+        warm (zero compiles — shared ProgramCache) and returns the
+        replica to service."""
+        rep = self._replicas[int(index)]
+        if rep.engine is None or rep.dead:
+            raise ValueError(f"replica {index} is not serving")
+        if rep.draining:
+            return
+        rep.draining = True
+        done, queued = rep.engine.evacuate(include_residents=False)
+        self._evac_done.extend(done)
+        self._requeue(queued)
+        log.info("fleet: rotating replica %d (%d resident(s) draining, "
+                 "%d queued moved)", rep.index,
+                 rep.engine.resident_count, len(queued))
+        self._update_snapshots()
+
+    def _restart_replica(self, rep: Replica) -> None:
+        """The supervised-restart path shared by the hard kill and the
+        in-process 124 (:class:`ServingUnrecoverable`): evacuate, count,
+        rebuild warm (or mark dead past the budget), re-queue."""
+        rep.restarts += 1                # budget spend (attempts)
+        rep.completed_prior = rep.completed_total()
+        self._collect(rep)               # drops/chunks it already owed
+        done, reqs = rep.engine.evacuate()
+        self._evac_done.extend(done)
+        # A dead replica is not draining: a zombie draining flag would
+        # keep the all-dead check below (and ``idle``) from ever firing.
+        rep.draining = False
+        if rep.restarts > self.restart_limit:
+            rep.dead = True
+            rep.engine = None
+            log.error("fleet: replica %d exhausted its restart budget "
+                      "(%d) and is removed from service", rep.index,
+                      self.restart_limit)
+        else:
+            # Counted HERE, where a restart actually happens — the
+            # budget-exhausted branch above removes the replica and
+            # restarts nothing.
+            self._restarts += 1
+            self._inc("fleet_replica_restarts")
+            rep.start(warm=True)
+            log.warning("fleet: replica %d restarted (restart %d/%d); "
+                        "re-queuing %d request(s)", rep.index,
+                        rep.restarts, self.restart_limit, len(reqs))
+        self._requeue(reqs)
+        self._update_snapshots()
+        if not any(r.in_service or r.draining for r in self._replicas):
+            raise FleetUnrecoverable(
+                "every replica is dead (per-replica restart budget "
+                f"{self.restart_limit} exhausted)")
+
+    def _requeue(self, reqs: List[Request]) -> None:
+        """Re-route evacuated requests.  Each placed one counts as
+        rerouted; one no candidate accepts is ANSWERED as a fleet-level
+        drop — a request may die with its replica's answer, never
+        silently."""
+        for req in reqs:
+            # The new owner re-decodes from step 0; its re-derived
+            # stream tokens must fall inside the fleet watermark.
+            self._stream_cur[req.request_id] = 0
+            placed = False
+            for rep in self._candidates():
+                with rep.on_device():
+                    if rep.engine.requeue(req):
+                        placed = True
+                        break
+            if placed:
+                self._rerouted += 1
+                self._inc("fleet_rerouted")
+                continue
+            self._stream_forget(req.request_id)   # terminal answer
+            self._dropped.append(Dropped(req.request_id, "admit_failed",
+                                         "fleet", meta=req.meta))
+
+    def _finish_rotation(self, rep: Replica) -> None:
+        """The drained replica's warm rebuild: fresh engine through the
+        shared ProgramCache (zero compiles), back in service."""
+        self._restarts += 1
+        self._inc("fleet_replica_restarts")
+        rep.completed_prior = rep.completed_total()
+        self._collect(rep)
+        rep.start(warm=True)
+        rep.draining = False
+        log.info("fleet: replica %d rotation complete — rebuilt warm and "
+                 "back in service", rep.index)
+
+    # -- scheduling --------------------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """One fleet scheduler step: step every replica that has work
+        (catching a replica's in-process 124 and restarting it in
+        place), finish any rotation whose residents drained, collect
+        drops and stream chunks.  Completions evacuated from killed
+        replicas (cache hits) are returned first."""
+        done: List[Completion] = list(self._evac_done)
+        self._evac_done.clear()
+        for rep in self._replicas:
+            if rep.engine is None:
+                continue
+            if rep.engine.idle:
+                if rep.draining:
+                    self._finish_rotation(rep)
+                continue
+            try:
+                with rep.on_device():
+                    comps = rep.engine.step()
+            except ServingUnrecoverable as e:
+                log.error("fleet: replica %d unrecoverable (%s) — "
+                          "supervised restart", rep.index, e)
+                self._restart_replica(rep)
+                done.extend(self._evac_done)
+                self._evac_done.clear()
+                continue
+            done.extend(comps)
+            self._collect(rep)
+        for comp in done:
+            self._stream_forget(comp.request_id)
+        self._update_snapshots()
+        return done
+
+    def _collect(self, rep: Replica) -> None:
+        if rep.engine is None:
+            return
+        drops = rep.engine.pop_dropped()
+        for d in drops:
+            # A drop is a TERMINAL answer: release the stream watermark
+            # (long-running fleets must not leak an entry per dropped
+            # streamed request).
+            self._stream_forget(d.request_id)
+        self._dropped.extend(drops)
+        for ch in rep.engine.pop_stream_chunks():
+            out = self._stream_filter(ch)
+            if out is not None:
+                self._stream_chunks.append(out)
+
+    # -- streaming continuity ----------------------------------------------
+
+    def _stream_filter(self, ch: StreamChunk) -> Optional[StreamChunk]:
+        """Fleet-level prefix discipline: only the tokens beyond the
+        fleet watermark reach the client, re-sequenced fleet-side — so a
+        restart's replayed tokens are filtered and the concatenation of
+        a request's chunks still equals its final caption bit for bit."""
+        rid = ch.request_id
+        sent = self._stream_sent.get(rid, 0)
+        cur = self._stream_cur.get(rid, 0) + len(ch.tokens)
+        self._stream_cur[rid] = cur
+        if cur <= sent:
+            return None
+        fresh = np.asarray(ch.tokens, np.int32)
+        if cur - sent < len(fresh):
+            fresh = fresh[len(fresh) - (cur - sent):]
+        self._stream_sent[rid] = cur
+        seq = self._stream_seq.get(rid, 0)
+        self._stream_seq[rid] = seq + 1
+        return StreamChunk(rid, seq, fresh, meta=ch.meta)
+
+    def _stream_forget(self, rid) -> None:
+        self._stream_sent.pop(rid, None)
+        self._stream_cur.pop(rid, None)
+        self._stream_seq.pop(rid, None)
+
+    # -- the engine scheduler surface --------------------------------------
+
+    def pop_dropped(self) -> List[Dropped]:
+        out, self._dropped = self._dropped, []
+        return out
+
+    def pop_stream_chunks(self) -> List[StreamChunk]:
+        out, self._stream_chunks = self._stream_chunks, []
+        return out
+
+    @property
+    def idle(self) -> bool:
+        # A pending rotation keeps the fleet non-idle: the next step()
+        # finishes it (rebuild + return to service), so step-driven
+        # loops (run_until_idle, the server's scheduler) can never
+        # stall a replica in ``draining`` forever.
+        return (not self._dropped and not self._stream_chunks
+                and not self._evac_done
+                and not any(r.draining for r in self._replicas)
+                and all(r.engine is None or r.engine.idle
+                        for r in self._replicas))
+
+    @property
+    def resident_count(self) -> int:
+        return sum(r.engine.resident_count for r in self._replicas
+                   if r.engine is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.engine.queue_depth for r in self._replicas
+                   if r.engine is not None)
+
+    def resident_requests(self) -> List[Request]:
+        out: List[Request] = []
+        for rep in self._replicas:
+            if rep.engine is not None:
+                out.extend(rep.engine.resident_requests())
+        return out
+
+    def drain(self, abort: Optional[Callable[[], bool]] = None
+              ) -> Tuple[List[Completion], List[Request]]:
+        """Fleet-wide graceful shutdown: drain every replica (reject its
+        queue, finish its residents), same contract as the engine."""
+        done: List[Completion] = list(self._evac_done)
+        self._evac_done.clear()
+        rejected: List[Request] = []
+        for rep in self._replicas:
+            if rep.engine is None:
+                continue
+            with rep.on_device():
+                d, r = rep.engine.drain(abort=abort)
+            done.extend(d)
+            rejected.extend(r)
+            self._collect(rep)
+        self._update_snapshots()
+        return done, rejected
+
+    def run_until_idle(self) -> List[Completion]:
+        done: List[Completion] = []
+        while not self.idle:
+            done.extend(self.step())
+        return done
+
+    def warm(self) -> Dict[str, Any]:
+        """Warm every replica (replica 0 pays the shared ProgramCache's
+        builds; the rest re-execute warm) -> ``stats()``."""
+        for rep in self._replicas:
+            if rep.engine is not None:
+                with rep.on_device():
+                    rep.engine.warm()
+        self._update_snapshots()
+        return self.stats()
+
+    # -- stats / health ----------------------------------------------------
+
+    def _engines(self) -> List[ServingEngine]:
+        return [r.engine for r in self._replicas if r.engine is not None]
+
+    def fleet_counters(self) -> Dict[str, int]:
+        """The ONE definition of the router's audit view (the
+        recovery_counters discipline: stats, health, the bench probe,
+        and serve_report all render exactly this dict)."""
+        return {
+            "fleet_routed": self._routed,
+            "fleet_rerouted": self._rerouted,
+            "fleet_shed": self._fleet_shed,
+            "fleet_replica_restarts": self._restarts,
+            "fleet_replica_kills": self._kills,
+        }
+
+    def recovery_counters(self) -> Dict[str, int]:
+        """Replica recovery counters summed fleet-wide (live engines
+        only — a restarted engine starts its ladder at 0, which is the
+        point: the FLEET counters carry the lifecycle history)."""
+        out: Dict[str, int] = {}
+        for eng in self._engines():
+            for k, v in eng.recovery_counters().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def cache_counters(self) -> Dict[str, Any]:
+        engines = self._engines()
+        out: Dict[str, Any] = {"cache_armed": False, "cache_hits": 0,
+                               "cache_misses": 0, "cache_evictions": 0,
+                               "cache_bypass": 0, "cache_errors": 0,
+                               "cache_entries": 0, "cache_capacity": 0}
+        for eng in engines:
+            c = eng.cache_counters()
+            out["cache_armed"] = out["cache_armed"] or c["cache_armed"]
+            for k in ("cache_hits", "cache_misses", "cache_evictions",
+                      "cache_bypass", "cache_errors"):
+                out[k] += c[k]
+            # One shared cache: entries/capacity are a property of the
+            # cache, not a per-replica sum.
+            if c["cache_armed"]:
+                out["cache_entries"] = c["cache_entries"]
+                out["cache_capacity"] = c["cache_capacity"]
+        return out
+
+    def stream_stats(self) -> Dict[str, Any]:
+        ttft: List[float] = []
+        gaps: List[float] = []
+        chunks = 0
+        for eng in self._engines():
+            t, g = eng.stream_windows_s()
+            ttft.extend(t)
+            gaps.extend(g)
+            chunks += eng.stream_stats()["stream_chunks"]
+        t_ms = np.asarray(ttft, np.float64) * 1e3
+        g_ms = np.asarray(gaps, np.float64) * 1e3
+        p = (lambda a, q: round(float(np.percentile(a, q)), 3)
+             if a.size else None)
+        return {
+            "stream_chunks": chunks,
+            "ttft_p50_ms": p(t_ms, 50),
+            "ttft_p99_ms": p(t_ms, 99),
+            "chunk_gap_p50_ms": p(g_ms, 50),
+            "chunk_gap_p99_ms": p(g_ms, 99),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """The engine ``stats()`` shape, aggregated fleet-wide, plus the
+        ``per_replica`` rows and the fleet lifecycle counters — so every
+        consumer of engine stats (server shed responses, the bench
+        probe, serve.py's exit line) reads a fleet unchanged."""
+        engines = self._engines()
+        estats = [e.stats() for e in engines]
+        lat = np.asarray([x for e in engines for x in e.latency_window_s()],
+                         np.float64) * 1e3
+        pct = (lambda q: float(np.percentile(lat, q)) if lat.size else None)
+        return {
+            "replicas": len(self._replicas),
+            "in_service": sum(1 for r in self._replicas if r.in_service),
+            "slots": sum(s["slots"] for s in estats),
+            "buckets": list(self.buckets),
+            "beam_size": self.beam_size,
+            "decode_chunk": self.chunk,
+            "residents": self.resident_count,
+            "queue_depth": self.queue_depth,
+            "submitted": self._routed,
+            "completed": sum(r.completed_total() for r in self._replicas),
+            "shed": self._fleet_shed,
+            "rejected_drain": sum(s["rejected_drain"] for s in estats),
+            # One shared ProgramCache: builds are a fleet-wide property,
+            # not a per-replica sum.
+            "compiles": estats[0]["compiles"] if estats else 0,
+            "chunk_dispatches": sum(s["chunk_dispatches"]
+                                    for s in estats),
+            "latency_p50_ms": pct(50),
+            "latency_p99_ms": pct(99),
+            "latency_mean_ms": float(lat.mean()) if lat.size else None,
+            "fleet": self.fleet_counters(),
+            "per_replica": self.per_replica(),
+            **self.recovery_counters(),
+            **self.cache_counters(),
+            **self.stream_stats(),
+        }
+
+    def per_replica(self) -> List[Dict[str, Any]]:
+        """Per-replica rows for serve_report / the bench line, from the
+        same snapshot table ``health()`` renders."""
+        with self._health_lock:
+            return [dict(s) for s in self._snapshots]
+
+    def _update_snapshots(self) -> None:
+        snaps: List[Dict[str, Any]] = []
+        for rep in self._replicas:
+            if rep.engine is None:
+                h: Dict[str, Any] = {"status": "dead", "queue_depth": 0,
+                                     "residents": 0, "recovery": {},
+                                     "compiles": 0}
+            else:
+                h = rep.engine.health()
+                if rep.draining:
+                    h["status"] = "draining"
+            h["replica"] = rep.index
+            h["restarts"] = rep.restarts
+            h["kills"] = rep.kills
+            h["completed"] = rep.completed_total()
+            snaps.append(h)
+        with self._health_lock:
+            self._snapshots = snaps
+
+    def health(self) -> Dict[str, Any]:
+        """The fleet health view: worst-of-replicas status plus the
+        per-replica detail.  Snapshot-backed — safe to call from the
+        watchdog's heartbeat thread while the scheduler owns the
+        engines."""
+        with self._health_lock:
+            per = [dict(s) for s in self._snapshots]
+        ranks = [_STATUS_RANK.get(s["status"],
+                                  _STATUS_RANK["degraded"])  # dead et al.
+                 for s in per]
+        worst = max(ranks) if ranks else _STATUS_RANK["degraded"]
+        status = next(k for k, v in _STATUS_RANK.items() if v == worst)
+        return {
+            "status": status,
+            "replicas": len(per),
+            "in_service": sum(1 for s in per
+                              if s["status"] in ("ok", "degraded")),
+            "queue_depth": sum(s["queue_depth"] for s in per),
+            "residents": sum(s["residents"] for s in per),
+            "completed": sum(s["completed"] for s in per),
+            "fleet": self.fleet_counters(),
+            "per_replica": per,
+        }
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _inc(self, name: str, n: float = 1) -> None:
+        if self._registry is not None:
+            self._registry.inc(name, n)

@@ -79,18 +79,25 @@ declare_order(*LOCK_ORDER)
 class CaptionServer:
     """Line-protocol server around one :class:`ServingEngine`.
 
-    ``feats_for(video_id)`` -> per-modality feature list (or None for an
-    unknown id) — the deployment decides where features come from (h5
-    lookup, upstream extractor, demo table).  ``handler`` is anything with
+    ``engine`` is anything speaking the engine scheduler surface — one
+    :class:`ServingEngine`, or a :class:`serving.fleet.FleetRouter`
+    spreading the same wire format over N replicas.  ``feats_for
+    (video_id)`` -> per-modality feature list (or None for an unknown
+    id) — the deployment decides where features come from (h5 lookup,
+    upstream extractor, demo table).  ``handler`` is anything with
     ``requested`` (bool) and ``signal_count`` (int) attributes — the
     preemption handler, or a test stub.  ``watchdog`` (optional) is
     beaten once per scheduler iteration; ``registry`` (optional) counts
-    intake errors and health queries.
+    intake errors and health queries.  ``health_source`` (optional)
+    replaces ``engine.health`` as the ``{"op": "health"}`` payload body
+    — the fleet front end plugs the router's worst-of-replicas view
+    (per-replica detail included) in here; the server still folds its
+    own draining state on top.
     """
 
     def __init__(self, engine: ServingEngine, vocab, feats_for,
                  *, handler=None, out=None, idle_sleep: float = 0.002,
-                 watchdog=None, registry=None):
+                 watchdog=None, registry=None, health_source=None):
         # The engine is single-owner state: reader threads parse lines
         # into the inbox, ONLY the scheduler loop may touch the engine
         # (cstlint:thread-ownership — the inbox-owns-intake discipline).
@@ -102,6 +109,7 @@ class CaptionServer:
         self.idle_sleep = idle_sleep
         self.watchdog = watchdog
         self.registry = registry
+        self._health_source = health_source
         if registry is not None:
             registry.declare("serve_bad_lines", "serve_health_queries")
         self._inbox: "queue.Queue" = queue.Queue()
@@ -183,8 +191,13 @@ class CaptionServer:
             obj["where"] = drop.where              # "queued" | "resident"
         elif drop.reason == "deadline_shed":
             obj["error"] = "expired"
-            obj["where"] = "queued"
+            # "queued" (the engine's p99 floor) or "fleet" (the router
+            # proved the deadline unmeetable at EVERY replica and shed
+            # at the fleet edge — SERVING.md "Fleet").
+            obj["where"] = drop.where
             obj["why"] = "deadline_unmeetable"
+        elif drop.reason == "admit_failed" and drop.where == "fleet":
+            obj["where"] = "fleet"
         self._write(respond, obj)
 
     def _respond_dropped_all(self) -> bool:
@@ -204,14 +217,20 @@ class CaptionServer:
     # -- the health plane --------------------------------------------------
 
     def health_payload(self) -> Dict[str, Any]:
-        """The ``{"op": "health"}`` response body — the engine's view
-        with the server's draining state folded in (``draining``
-        dominates ``degraded`` dominates ``ok``)."""
-        h = self.engine.health()
-        h["status"] = health_status(
-            draining=self._draining or bool(
-                self.handler is not None and self.handler.requested),
-            recovering=(h["status"] == "degraded"))
+        """The ``{"op": "health"}`` response body — the health source's
+        view (``engine.health()`` by default; the fleet router's
+        worst-of-replicas payload when plugged in) with the server's
+        draining state folded in (``draining`` dominates ``degraded``
+        dominates ``ok``; a source already reporting ``draining`` — a
+        rotating fleet replica — stays ``draining``)."""
+        source = (self._health_source if self._health_source is not None
+                  else self.engine.health)
+        h = source()
+        if h["status"] not in ("draining",):
+            h["status"] = health_status(
+                draining=self._draining or bool(
+                    self.handler is not None and self.handler.requested),
+                recovering=(h["status"] == "degraded"))
         h["op"] = "health"
         return h
 

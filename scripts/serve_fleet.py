@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Fleet-serving CLI: the JSONL front end over N self-healing replicas.
+
+``scripts/serve.py`` owns one ServingEngine; this front end builds a
+:class:`serving.fleet.FleetRouter` over ``--serve_replicas`` engine
+replicas (per-device where this host has more than one accelerator,
+in-process otherwise) and drives it through the SAME
+``serving.server.CaptionServer`` — the wire format, backpressure,
+drain/SIGTERM, and health contracts are identical, so a client cannot
+tell one engine from a fleet except by throughput (SERVING.md "Fleet").
+
+    # zero-setup demo fleet (3 replicas):
+    python scripts/serve_fleet.py --serve_demo 1 --serve_replicas 3
+
+    # checkpoint mode, same flags as serve.py:
+    python scripts/serve_fleet.py --checkpoint_path <dir> \\
+        --test_feat_h5 ... --test_label_h5 ... --test_info_json ... \\
+        --serve_replicas 4
+
+Fleet specifics:
+
+- All replicas share ONE ProgramCache (compile once fleet-wide; a
+  replica restart re-warms with zero builds) and ONE exact-result cache
+  (a caption decoded anywhere is a hit everywhere).
+- ``{"op": "health"}`` answers the FLEET view: worst-of-replicas status
+  plus per-replica detail (the router's snapshots), via the server's
+  pluggable health source.  The heartbeat file carries the same view.
+- ``--fault_plan 'serve_wedge@replica=K'`` (and the other serving kinds)
+  targets the fault at replica K's engine (RESILIENCE.md).
+- A replica whose self-healing ladder exhausts (in-process exit 124) is
+  restarted by the router with its residents re-queued; only when every
+  replica burns ``--serve_restart_limit`` does this process exit 124
+  (``FleetUnrecoverable``) for supervised restart.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from cst_captioning_tpu.opts import parse_opts  # noqa: E402
+
+log = logging.getLogger("cst_captioning_tpu.serve_fleet")
+
+
+def main(argv=None) -> int:
+    opt = parse_opts(argv)
+    from cst_captioning_tpu.opts import (warn_serve_deadline,
+                                         warn_serving_decode_chunk)
+    from cst_captioning_tpu.utils.platform import (configure_cli_logging,
+                                                   enable_compile_cache)
+
+    configure_cli_logging(opt.loglevel)
+    warn_serving_decode_chunk(opt)
+    warn_serve_deadline(opt)
+    enable_compile_cache(getattr(opt, "compile_cache_dir", ""))
+
+    import jax
+
+    from serve import build_checkpoint_backend, build_demo_backend  # noqa: E402
+    from cst_captioning_tpu.resilience.faults import FaultPlan
+    from cst_captioning_tpu.resilience.preemption import PreemptionHandler
+    from cst_captioning_tpu.serving.buckets import ProgramCache, parse_buckets
+    from cst_captioning_tpu.serving.cache import ResultCache
+    from cst_captioning_tpu.serving.engine import ServingEngine
+    from cst_captioning_tpu.serving.fleet import FleetRouter, FleetUnrecoverable
+    from cst_captioning_tpu.serving.server import CaptionServer
+    from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+    handler = PreemptionHandler().install()
+    registry = MetricsRegistry()
+    plan = FaultPlan.parse(getattr(opt, "fault_plan", None))
+    if plan is not None:
+        plan.bind_metrics(registry)
+        log.warning("CHAOS: fleet fault plan armed: %s", plan)
+
+    ds = None
+    if opt.serve_demo:
+        model, params, vocab, feat_shapes, feats_for = \
+            build_demo_backend(opt)
+    else:
+        from cst_captioning_tpu.data.dataset import CaptionDataset, SplitPaths
+
+        if not opt.test_feat_h5:
+            print("serve_fleet.py: checkpoint mode needs --test_feat_h5/"
+                  "--test_label_h5/--test_info_json (or pass "
+                  "--serve_demo 1)", file=sys.stderr)
+            return 2
+        ds = CaptionDataset(SplitPaths(
+            feat_h5=list(opt.test_feat_h5), label_h5=opt.test_label_h5,
+            info_json=opt.test_info_json,
+            cocofmt_json=opt.test_cocofmt_file))
+        model, params, vocab, feat_shapes, feats_for, opt = \
+            build_checkpoint_backend(opt, ds)
+
+    tracer = None
+    if getattr(opt, "trace_dir", None):
+        from cst_captioning_tpu.telemetry.spans import SpanTracer
+
+        tracer = SpanTracer(opt.trace_dir)
+
+    # Shared across every replica AND every restarted engine: compile
+    # once fleet-wide, one result entry per distinct video fleet-wide.
+    programs = ProgramCache(registry)
+    result_cache = (ResultCache(opt.serve_cache)
+                    if opt.serve_cache else None)
+
+    def engine_factory(replica: int) -> ServingEngine:
+        return ServingEngine(
+            model, {"params": params}, feat_shapes,
+            max_len=opt.max_length, beam_size=opt.beam_size,
+            length_norm=opt.length_norm,
+            decode_chunk=getattr(opt, "decode_chunk", 8),
+            bucket_sizes=parse_buckets(opt.serve_buckets),
+            queue_limit=opt.serve_queue_limit,
+            deadline_ms=opt.serve_deadline_ms,
+            fault_plan=(plan.for_replica(replica)
+                        if plan is not None else None),
+            recover=bool(opt.serve_recover),
+            retry_limit=opt.serve_retry_limit,
+            rebuild_limit=opt.serve_rebuild_limit,
+            step_budget_ms=opt.serve_step_budget_ms,
+            result_cache=result_cache,
+            program_cache=programs,
+            registry=registry, tracer=tracer)
+
+    local = jax.local_devices()
+    devices = local if len(local) > 1 else None
+    router = FleetRouter(engine_factory, opt.serve_replicas,
+                         devices=devices,
+                         restart_limit=opt.serve_restart_limit,
+                         registry=registry)
+    router.warm()
+    log.info("fleet warm: %d replica(s) over %d device(s), buckets=%s "
+             "beam=%d chunk=%d compiles=%d", opt.serve_replicas,
+             len(devices) if devices else 1, list(router.buckets),
+             router.beam_size, router.chunk, router.stats()["compiles"])
+
+    server = CaptionServer(router, vocab, feats_for, handler=handler,
+                           registry=registry,
+                           health_source=router.health)
+
+    watchdog = None
+    if opt.serve_heartbeat_file or opt.wedge_timeout > 0:
+        from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
+
+        watchdog = ProgressWatchdog(
+            opt.wedge_timeout,
+            describe=lambda: "fleet scheduler loop",
+            heartbeat_path=opt.serve_heartbeat_file,
+            payload=lambda: {"serving": server.health_payload(),
+                             **registry.heartbeat_payload()},
+            heartbeat_interval_s=1.0).start()
+        server.watchdog = watchdog
+    try:
+        try:
+            if opt.serve_port:
+                port = 0 if opt.serve_port < 0 else opt.serve_port
+                rc = server.run_socket(port)
+            else:
+                rc = server.run_stdin()
+        except FleetUnrecoverable as e:
+            from cst_captioning_tpu.resilience.exitcodes import (
+                EXIT_WEDGE,
+                describe,
+            )
+
+            print(f"serve_fleet: UNRECOVERABLE: {e}; exiting {EXIT_WEDGE} "
+                  f"({describe(EXIT_WEDGE)})", file=sys.stderr)
+            rc = EXIT_WEDGE
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        stats = router.stats()
+        print("serve_fleet: " + json.dumps(stats), file=sys.stderr)
+        if opt.result_file:
+            from cst_captioning_tpu.resilience.integrity import (
+                atomic_json_write,
+            )
+
+            atomic_json_write(opt.result_file,
+                              {"stats": stats,
+                               "health": router.health(),
+                               "telemetry": registry.snapshot()}, indent=2)
+        if tracer is not None:
+            tracer.close()
+        if ds is not None:
+            ds.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,8 +69,10 @@ def main(argv=None) -> int:
               f"(metric {SERVE_METRIC!r}); run "
               "`python bench.py --stage serving`", file=sys.stderr)
         return 1
+    fleet = rec.get("fleet") or {}
     rows = [
-        ("captions/s", fmt(rec.get("value"))),
+        ("captions/s" + ("/fleet" if fleet.get("enabled") else ""),
+         fmt(rec.get("value"))),
         ("latency p50", fmt(rec.get("latency_p50_ms"), " ms")),
         ("latency p99", fmt(rec.get("latency_p99_ms"), " ms")),
         ("latency mean", fmt(rec.get("latency_mean_ms"), " ms")),
@@ -116,6 +118,29 @@ def main(argv=None) -> int:
                 ("cache-off twin", f"{fmt(rec.get('cache_off_captions_per_sec'))}"
                                    " caps/s (speedup "
                                    f"{fmt(rec.get('cache_speedup'))}x)"))
+    if fleet.get("enabled"):
+        killed = fleet.get("killed_replica")
+        rows += [
+            ("fleet", f"{fmt(fleet.get('replicas'))} replicas — routed "
+                      f"{fmt(fleet.get('fleet_routed'))} "
+                      f"(rerouted {fmt(fleet.get('fleet_rerouted'))}, "
+                      f"fleet-shed {fmt(fleet.get('fleet_shed'))})"),
+            ("fleet lifecycle",
+             f"{fmt(fleet.get('fleet_replica_restarts'))} restarts / "
+             f"{fmt(fleet.get('fleet_replica_kills'))} kills"
+             + (f" (drill killed replica {killed})"
+                if killed is not None else "")),
+            ("fleet parity", f"parity_ok={fleet.get('parity_ok')} "
+                             f"({fmt(fleet.get('parity_mismatches'))} "
+                             "caption(s) != the single-engine run)"),
+        ]
+        for pr in fleet.get("per_replica") or []:
+            rows.append(
+                (f"  replica {pr.get('replica')}",
+                 f"{fmt(pr.get('completed'))} completed, "
+                 f"status {pr.get('status')}, "
+                 f"{fmt(pr.get('restarts'))} restart(s) / "
+                 f"{fmt(pr.get('kills'))} kill(s)"))
     rows += [
         ("recompiles after warmup", fmt(rec.get("recompiles_after_warmup"))),
         ("expired / deadline-shed", f"{fmt(rec.get('expired'))} / "
@@ -159,6 +184,11 @@ def main(argv=None) -> int:
         print("  !! the cached probe did not beat its cache-off twin "
               f"({rec['value']} <= {twin_cps} caps/s): the result cache "
               "is not paying on this run", file=sys.stderr)
+        rc = 1
+    if fleet.get("enabled") and fleet.get("parity_ok") is False:
+        print("  !! fleet caption(s) not bit-identical to the fault-free "
+              "single-engine reference run: the fleet bit-identity "
+              "contract is broken (SERVING.md 'Fleet')", file=sys.stderr)
         rc = 1
     if stream.get("enabled") and stream.get("prefix_ok") is False:
         print("  !! streamed chunks are not prefix-consistent with the "

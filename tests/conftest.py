@@ -46,6 +46,7 @@ os.environ["CST_SERVE_BUCKETS"] = ""
 os.environ["CST_SERVE_QUEUE_LIMIT"] = ""
 os.environ["CST_SERVE_DEADLINE_MS"] = ""
 os.environ["CST_SERVE_CACHE"] = ""
+os.environ["CST_SERVE_REPLICAS"] = ""
 
 import jax  # noqa: E402
 

@@ -1,0 +1,775 @@
+"""Fleet serving (ISSUE 13): health-aware router over N self-healing
+engine replicas.
+
+Fast slice (tier-1, lock-sanitizer armed like the PR 9/11 slices):
+- the ``@replica=K`` fault-plan axis (grammar, rejection, per-replica
+  derivation firing once at any index);
+- routing: load spread with ``fleet_routed``/``fleet_rerouted``
+  accounting, route-around-``degraded``, fleet-edge deadline shed with
+  ``where: fleet`` at the router AND on the server wire;
+- THE fleet acceptance drill: replica-targeted faults + a hard replica
+  kill mid-flight — every request answered, captions BIT-IDENTICAL to a
+  fault-free single-engine run, zero program builds after warmup
+  including through the replica restart (shared ProgramCache);
+- lifecycle: the in-process exit-124 (``ServingUnrecoverable``) consumed
+  as "restart replica, re-queue residents"; the restart budget
+  escalating to ``FleetUnrecoverable``; draining rotation admitting
+  nothing to the rotating replica and rebuilding it warm;
+- one shared result cache across replicas; streamed requests staying
+  prefix-consistent across a replica kill (fleet watermarks);
+- the fleet health view (worst-of-replicas + per-replica detail)
+  through the server's pluggable health source;
+- serve_report's fleet rows + bit-identity gate; bench cache identity
+  carrying ``replicas``; doc pins (SERVING.md fleet counter table,
+  RESILIENCE.md ``@replica=K`` grammar row).
+
+The subprocess drill (scripts/serve_fleet.py under a real ``@replica``
+fault plan) is marked ``slow`` and runs via ``make serve-fleet-chaos``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.resilience.faults import ANY_INDEX, FaultPlan
+from cst_captioning_tpu.serving.buckets import ProgramCache
+from cst_captioning_tpu.serving.cache import ResultCache
+from cst_captioning_tpu.serving.engine import ServingEngine, _trim_eos
+from cst_captioning_tpu.serving.fleet import (
+    FLEET_COUNTERS,
+    FleetRouter,
+    FleetUnrecoverable,
+)
+from cst_captioning_tpu.serving.server import CaptionServer
+from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """The fleet fast slice runs sanitizer-armed (the PR 11 discipline):
+    router + engine + registry locks are re-validated against the
+    declared LOCK_ORDER under every drill in this file."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt from a child process: "
+        f"{receipt.read_text()}")
+
+
+def make_variables(model, feats, eos_bias=0.4):
+    variables = model.init(jax.random.PRNGKey(0), feats,
+                           np.zeros((B, MAX_LEN), np.int32))
+    params = {**variables["params"]}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(eos_bias)
+    return {"params": params}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """EOS-suppressed model (captions run the full MAX_LEN) so residents
+    stay in flight across the kill/rotation windows deterministically."""
+    model = CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    feats_np = np.random.default_rng(0).normal(
+        size=(B, T, D)).astype(np.float32) * 2.0
+    variables = make_variables(model, [jnp.asarray(feats_np)],
+                               eos_bias=-2.0)
+    return model, variables, feats_np
+
+
+def build_fleet(setup, replicas=2, *, registry=None, plan=None,
+                result_cache=None, recover=True, retry_limit=2,
+                rebuild_limit=2, restart_limit=3, deadline_ms=0.0,
+                queue_limit=0, clock=None):
+    """A fleet over shared ProgramCache (+ optional shared result
+    cache); returns (fleet, programs, factory) — the factory doubles as
+    the fault-free single-engine reference builder."""
+    model, variables, _ = setup
+    programs = ProgramCache(registry)
+
+    def factory(k, _plan=None):
+        use = plan.for_replica(k) if (plan is not None and _plan is None) \
+            else _plan
+        kw = {}
+        if clock is not None:
+            kw["clock"] = clock
+        return ServingEngine(
+            model, variables, [(T, D)], max_len=MAX_LEN, decode_chunk=2,
+            bucket_sizes=(1, 2), queue_limit=queue_limit,
+            deadline_ms=deadline_ms, fault_plan=use, recover=recover,
+            retry_limit=retry_limit, rebuild_limit=rebuild_limit,
+            result_cache=result_cache, program_cache=programs,
+            registry=registry, **kw)
+
+    fleet_kw = {}
+    if clock is not None:
+        fleet_kw["clock"] = clock
+    fleet = FleetRouter(factory, replicas, restart_limit=restart_limit,
+                        registry=registry, **fleet_kw)
+    return fleet, programs, factory
+
+
+def reference_tokens(factory, vids):
+    """Fault-free single-engine decode of every video — the fleet
+    acceptance baseline (plan-free, cache-free by construction: the
+    factory's _plan override pins None)."""
+    eng = factory(0, _plan=None)
+    for i, f in enumerate(vids):
+        eng.submit(("ref", i), f)
+    return {c.request_id[1]: np.asarray(c.tokens)
+            for c in eng.run_until_idle()}
+
+
+def make_videos(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [[rng.standard_normal((T, D)).astype(np.float32)]
+            for _ in range(n)]
+
+
+# -- @replica=K fault axis -------------------------------------------------
+
+
+def test_replica_axis_parses_and_derives():
+    plan = FaultPlan.parse("serve_wedge@replica=1,serve_garble@req=2")
+    assert "serve_wedge@replica=1" in str(plan)
+    # The parsed plan never fires a replica spec itself (@req specs
+    # still work); only the per-replica derivative does.
+    assert not plan.fire("serve_wedge", 0)
+    assert plan.fire("serve_garble", 2)
+    d1 = plan.for_replica(1)
+    assert d1 is not None and d1.specs[0].at == ANY_INDEX
+    # Fires at the FIRST probed index, once — any index, single shot.
+    assert d1.fire("serve_wedge", 7)
+    assert not d1.fire("serve_wedge", 8)
+    # Untargeted replicas pay nothing: no derived plan at all.
+    assert plan.for_replica(0) is None
+
+
+def test_replica_axis_rejects_bad_specs():
+    with pytest.raises(ValueError, match="cannot target a fleet replica"):
+        FaultPlan.parse("nan_grad@replica=0")
+    with pytest.raises(ValueError, match="no \\*K repeat"):
+        FaultPlan.parse("serve_wedge@replica=0*2")
+    # And the CLI surfaces it as a one-line usage error.
+    from cst_captioning_tpu.opts import parse_opts
+
+    with pytest.raises(SystemExit) as exc:
+        parse_opts(["--fault_plan", "wedge@replica=1"])
+    assert exc.value.code == 2
+    ns = parse_opts(["--fault_plan", "admit_err@replica=2"])
+    assert ns.fault_plan == "admit_err@replica=2"
+
+
+# -- routing ---------------------------------------------------------------
+
+
+def test_fleet_spreads_load_and_counts(setup):
+    registry = MetricsRegistry()
+    fleet, _, _ = build_fleet(setup, 2, registry=registry)
+    fleet.warm()
+    vids = make_videos(6)
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done += fleet.run_until_idle()
+    assert sorted(c.request_id for c in done) == list(range(6))
+    st = fleet.stats()
+    assert st["fleet"]["fleet_routed"] == 6
+    assert registry.counter("fleet_routed") == 6
+    # Least-loaded routing put work on BOTH replicas.
+    per = st["per_replica"]
+    assert len(per) == 2 and all(p["completed"] > 0 for p in per)
+    # Declared at 0: every fleet counter exists even where nothing fired.
+    snap = registry.snapshot()["counters"]
+    for name in FLEET_COUNTERS:
+        assert name in snap, name
+
+
+def test_fleet_captions_bit_identical_to_single_engine(setup):
+    fleet, _, factory = build_fleet(setup, 3)
+    fleet.warm()
+    vids = make_videos(6, seed=5)
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    got = {c.request_id: np.asarray(c.tokens)
+           for c in fleet.run_until_idle()}
+    ref = reference_tokens(factory, vids)
+    assert sorted(got) == list(range(6))
+    for i in range(6):
+        np.testing.assert_array_equal(got[i], ref[i])
+
+
+def test_route_around_degraded(setup):
+    fleet, _, _ = build_fleet(setup, 2)
+    fleet.warm()
+    # Replica 0 just recovered from something: health 'degraded'.
+    fleet._replicas[0].engine._note_recovery_event()
+    for i, f in enumerate(make_videos(3, seed=2)):
+        assert fleet.submit(i, f)
+    # Everything routed AROUND the degraded replica.
+    assert fleet._replicas[0].engine.queue_depth == 0
+    assert fleet._replicas[0].engine.resident_count == 0
+    assert fleet._replicas[1].engine.queue_depth + \
+        fleet._replicas[1].engine.resident_count == 3
+    fleet._update_snapshots()      # health() is snapshot-backed
+    assert fleet.health()["per_replica"][0]["status"] == "degraded"
+    done = fleet.run_until_idle()
+    assert len(done) == 3
+
+
+def test_fleet_edge_shed_where_fleet(setup):
+    registry = MetricsRegistry()
+    fleet, _, _ = build_fleet(setup, 2, registry=registry,
+                              deadline_ms=1.0)
+    fleet.warm()
+    # Every replica's p99 chunk floor is known and far above 1ms.
+    for rep in fleet._replicas:
+        rep.engine._chunk_wall.extend([0.05] * 8)
+    assert fleet.submit("r1", make_videos(1)[0], deadline_ms=1.0)
+    drops = fleet.pop_dropped()
+    assert len(drops) == 1
+    assert drops[0].reason == "deadline_shed" and drops[0].where == "fleet"
+    assert registry.counter("fleet_shed") == 1
+    # Nothing ever queued at a replica.
+    assert all(r.engine.queue_depth == 0 for r in fleet._replicas)
+    # An unknown floor at any replica = not provable = admit normally.
+    fleet._replicas[0].engine._chunk_wall.clear()
+    assert fleet.submit("r2", make_videos(1)[0], deadline_ms=1.0)
+    assert not fleet.pop_dropped()
+
+
+def test_server_renders_fleet_shed_where_fleet(setup):
+    from cst_captioning_tpu.serving.engine import Dropped
+
+    fleet, _, _ = build_fleet(setup, 2)
+    fleet.warm()
+    out = []
+    server = CaptionServer(fleet, vocab=None, feats_for=lambda v: None)
+    server._respond_dropped(Dropped("x", "deadline_shed", "fleet",
+                                    meta={"id": 9, "video_id": "v",
+                                          "respond": out.append}))
+    obj = json.loads(out[0])
+    assert obj["error"] == "expired" and obj["where"] == "fleet"
+    assert obj["why"] == "deadline_unmeetable"
+
+
+# -- lifecycle: kill / 124 / budget / rotation -----------------------------
+
+
+def test_kill_replica_requeues_bit_identical_zero_compiles(setup):
+    registry = MetricsRegistry()
+    fleet, programs, factory = build_fleet(setup, 2, registry=registry)
+    warm = fleet.warm()["compiles"]
+    vids = make_videos(6, seed=3)
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done += fleet.step()          # residents mid-flight on both replicas
+    assert fleet._replicas[0].engine.resident_count > 0
+    fleet.kill_replica(0)
+    done += fleet.run_until_idle()
+    # Every request answered with a caption (none dropped), captions
+    # bit-identical to the fault-free single-engine run.
+    got = {c.request_id: np.asarray(c.tokens) for c in done}
+    assert sorted(got) == list(range(6))
+    assert fleet.pop_dropped() == []
+    ref = reference_tokens(factory, vids)
+    for i in range(6):
+        np.testing.assert_array_equal(got[i], ref[i])
+    # Zero builds through the kill/restart: the restarted replica
+    # re-warmed entirely from the shared ProgramCache.
+    assert programs.builds == warm
+    st = fleet.stats()["fleet"]
+    assert st["fleet_replica_kills"] == 1
+    assert st["fleet_replica_restarts"] == 1
+    assert st["fleet_rerouted"] >= 1
+    assert registry.counter("fleet_replica_kills") == 1
+
+
+def test_unrecoverable_replica_consumed_as_supervised_restart(setup):
+    """The exit-124 taxonomy one level down: a replica whose self-healing
+    ladder exhausts (ServingUnrecoverable) is restarted by the router
+    with its residents re-queued — the fleet answer to what a process
+    supervisor does with exit 124."""
+    plan = FaultPlan.parse("serve_wedge@replica=0")
+    fleet, programs, factory = build_fleet(
+        setup, 2, plan=plan, retry_limit=0, rebuild_limit=0)
+    warm = fleet.warm()["compiles"]
+    vids = make_videos(4, seed=4)
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done = fleet.run_until_idle()
+    got = {c.request_id: np.asarray(c.tokens) for c in done}
+    assert sorted(got) == list(range(4))
+    ref = reference_tokens(factory, vids)
+    for i in range(4):
+        np.testing.assert_array_equal(got[i], ref[i])
+    st = fleet.stats()["fleet"]
+    assert st["fleet_replica_restarts"] == 1
+    assert st["fleet_replica_kills"] == 0      # a 124, not a drill kill
+    assert programs.builds == warm
+
+
+def test_restart_budget_escalates_to_fleet_unrecoverable(setup):
+    fleet, _, _ = build_fleet(setup, 2, restart_limit=0)
+    fleet.warm()
+    vids = make_videos(2, seed=6)
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    fleet.step()
+    fleet.kill_replica(0)          # budget 0: replica 0 is now dead
+    assert fleet.health()["per_replica"][0]["status"] == "dead"
+    # The fleet view degrades (capacity lost) but keeps serving.
+    assert fleet.health()["status"] == "degraded"
+    with pytest.raises(FleetUnrecoverable):
+        fleet.kill_replica(1)      # last replica out -> process-level 124
+    # Still no silent loss: the evacuated requests were ANSWERED.
+    drops = fleet.pop_dropped()
+    assert {d.request_id for d in drops} <= {0, 1}
+    assert all(d.reason == "admit_failed" and d.where == "fleet"
+               for d in drops)
+    # Review regression: budget-exhausted removals are NOT restarts —
+    # both kills went straight to dead, nothing was rebuilt.
+    assert fleet.fleet_counters()["fleet_replica_restarts"] == 0
+    assert fleet.fleet_counters()["fleet_replica_kills"] == 2
+
+
+def test_death_mid_rotation_clears_draining_and_escalates(setup):
+    """Review regression: a replica that dies past its budget WHILE
+    draining must drop the draining flag — otherwise the zombie flag
+    blocks FleetUnrecoverable forever (submit sheds instead of exiting
+    124) and ``idle`` never settles."""
+    fleet, _, _ = build_fleet(setup, 1, restart_limit=0)
+    fleet.warm()
+    assert fleet.submit(0, make_videos(1, seed=16)[0])
+    fleet.step()
+    fleet.rotate(0)                # the only replica is draining...
+    with pytest.raises(FleetUnrecoverable):
+        fleet.kill_replica(0)      # ...and dies mid-rotation
+    assert not fleet._replicas[0].draining
+    drops = fleet.pop_dropped()    # the resident was still answered
+    assert [d.request_id for d in drops] == [0]
+    assert fleet.idle              # no zombie draining flag
+
+
+def test_rotation_admits_nothing_and_rebuilds_warm(setup):
+    registry = MetricsRegistry()
+    fleet, programs, _ = build_fleet(setup, 2, registry=registry)
+    warm = fleet.warm()["compiles"]
+    vids = make_videos(4, seed=7)
+    for i, f in enumerate(vids[:2]):
+        assert fleet.submit(i, f)
+    fleet.step()                    # residents on both replicas
+    fleet.rotate(0)
+    assert fleet.health()["per_replica"][0]["status"] == "draining"
+    # Worst-of-replicas: a rotating replica shows in the fleet status.
+    assert fleet.health()["status"] == "draining"
+    # New traffic admits NOTHING to the rotating replica.
+    before = fleet._replicas[0].engine.queue_depth
+    for i, f in enumerate(vids[2:], start=2):
+        assert fleet.submit(i, f)
+    assert fleet._replicas[0].engine.queue_depth == before == 0
+    done = fleet.run_until_idle()
+    assert sorted(c.request_id for c in done) == list(range(4))
+    # Rotation finished: rebuilt warm (zero builds), back in service.
+    assert fleet.health()["per_replica"][0]["status"] == "ok"
+    assert fleet._replicas[0].in_service
+    assert programs.builds == warm
+    assert registry.counter("fleet_replica_restarts") == 1
+
+
+def test_replica_targeted_fault_hits_only_that_replica(setup):
+    plan = FaultPlan.parse("serve_garble@replica=1")
+    registry = MetricsRegistry()
+    fleet, _, factory = build_fleet(setup, 2, plan=plan,
+                                    registry=registry)
+    fleet.warm()
+    vids = make_videos(4, seed=8)
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done = fleet.run_until_idle()
+    assert len(done) == 4
+    rec0 = fleet._replicas[0].engine.recovery_counters()
+    rec1 = fleet._replicas[1].engine.recovery_counters()
+    assert rec0["garble_detected"] == 0
+    assert rec1["garble_detected"] == 1 and rec1["chunk_retries"] >= 1
+    ref = reference_tokens(factory, vids)
+    for c in done:
+        np.testing.assert_array_equal(np.asarray(c.tokens),
+                                      ref[c.request_id])
+
+
+def test_fleet_acceptance_drill_all_faults_plus_kill(setup):
+    """THE fleet acceptance drill (ISSUE 13): seeded serve_wedge /
+    serve_garble / admit_err fired at individual replicas plus one hard
+    replica kill/restart — every request answered, captions
+    bit-identical to the fault-free single-engine run, zero post-warmup
+    compiles fleet-wide including through the restart, every fault
+    visible in the counters."""
+    plan = FaultPlan.parse(
+        "serve_wedge@replica=0,serve_garble@replica=1,admit_err@replica=0")
+    registry = MetricsRegistry()
+    plan.bind_metrics(registry)
+    fleet, programs, factory = build_fleet(setup, 3, plan=plan,
+                                           registry=registry)
+    warm = fleet.warm()["compiles"]
+    vids = make_videos(9, seed=12)
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done += fleet.step()
+    fleet.kill_replica(2)
+    done += fleet.run_until_idle()
+    got = {c.request_id: np.asarray(c.tokens) for c in done}
+    assert sorted(got) == list(range(9))      # every request answered
+    assert fleet.pop_dropped() == []
+    ref = reference_tokens(factory, vids)
+    for i in range(9):
+        np.testing.assert_array_equal(got[i], ref[i])
+    assert programs.builds == warm            # zero compiles fleet-wide
+    rec = fleet.recovery_counters()
+    # Each targeted fault fired exactly once and was absorbed in place
+    # (rec sums LIVE engines; the killed replica 2 carried no faults).
+    assert registry.counter("fault_serve_wedge") == 1
+    assert registry.counter("fault_serve_garble") == 1
+    assert registry.counter("fault_admit_err") == 1
+    assert rec["wedge_detected"] == 1
+    assert rec["garble_detected"] == 1
+    assert rec["admit_errors"] == 1
+    st = fleet.stats()["fleet"]
+    assert st["fleet_replica_kills"] == 1
+    assert st["fleet_replica_restarts"] == 1
+
+
+# -- shared result cache / streaming continuity ----------------------------
+
+
+def test_shared_result_cache_across_replicas(setup):
+    registry = MetricsRegistry()
+    cache = ResultCache(16)
+    fleet, _, _ = build_fleet(setup, 2, registry=registry,
+                              result_cache=cache)
+    fleet.warm()
+    vid = make_videos(1, seed=9)[0]
+    assert fleet.submit("a", vid)
+    first = fleet.run_until_idle()
+    assert len(first) == 1 and not first[0].cache_hit
+    # The same video again: wherever it routes, the shared cache hits —
+    # one decode per distinct video FLEET-wide.
+    assert fleet.submit("b", vid)
+    second = fleet.run_until_idle()
+    assert len(second) == 1 and second[0].cache_hit
+    np.testing.assert_array_equal(np.asarray(second[0].tokens),
+                                  np.asarray(first[0].tokens))
+    cc = fleet.stats()
+    assert cc["cache_hits"] == 1 and cc["cache_misses"] == 1
+    assert cc["cache_entries"] == 1
+
+
+def test_stream_prefix_consistent_across_replica_kill(setup):
+    """The fleet watermark: a killed replica's streamed request replays
+    from step 0 on its new owner; the client still sees each token
+    exactly once and the concatenation equals the final caption."""
+    fleet, _, _ = build_fleet(setup, 2)
+    fleet.warm()
+    vids = make_videos(2, seed=10)
+    chunks = {0: [], 1: []}
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f, stream=True)
+    done += fleet.step()            # first chunks emitted
+    for ch in fleet.pop_stream_chunks():
+        chunks[ch.request_id].append(ch)
+    assert any(chunks.values())
+    fleet.kill_replica(0)
+    while not fleet.idle:
+        done += fleet.step()
+        for ch in fleet.pop_stream_chunks():
+            chunks[ch.request_id].append(ch)
+    assert sorted(c.request_id for c in done) == [0, 1]
+    for c in done:
+        got = (np.concatenate([np.asarray(x.tokens) for x in
+                               sorted(chunks[c.request_id],
+                                      key=lambda x: x.seq)])
+               if chunks[c.request_id] else np.zeros((0,), np.int32))
+        np.testing.assert_array_equal(got, _trim_eos(c.tokens))
+        # Fleet-side re-sequencing: seq is gapless from 0.
+        seqs = [x.seq for x in sorted(chunks[c.request_id],
+                                      key=lambda x: x.seq)]
+        assert seqs == list(range(len(seqs)))
+
+
+def test_requeue_preserves_no_cache(setup):
+    """Review regression: an evacuated no_cache request must stay
+    no_cache on its new engine — the per-request bypass survives a
+    replica kill instead of silently hitting the shared cache."""
+    cache = ResultCache(16)
+    fleet, _, _ = build_fleet(setup, 2, result_cache=cache)
+    fleet.warm()
+    vid = make_videos(1, seed=13)[0]
+    # Prime the shared cache with this video's caption.
+    assert fleet.submit("prime", vid)
+    assert fleet.run_until_idle()[0].cache_hit is False
+    # A no_cache twin, evacuated mid-flight by a replica kill.
+    assert fleet.submit("bypass", vid, no_cache=True)
+    owner = next(r.index for r in fleet._replicas
+                 if r.engine.queue_depth + r.engine.resident_count)
+    fleet.step()
+    fleet.kill_replica(owner)
+    done = fleet.run_until_idle()
+    comp = next(c for c in done if c.request_id == "bypass")
+    assert comp.cache_hit is False           # decoded fresh, post-requeue
+    assert comp.decode_steps > 0
+    # The requeued submission bypassed again on its NEW engine (stats
+    # sum live engines; the killed engine's count retired with it).
+    assert fleet.stats()["cache_bypass"] >= 1
+
+
+def test_dropped_stream_watermark_forgotten_and_id_reuse(setup):
+    """Review regression: a dropped streamed request releases its fleet
+    watermark, and a REUSED request id streams from scratch instead of
+    being filtered against the stale state."""
+    clock_t = [0.0]
+    clock = lambda: clock_t[0]  # noqa: E731
+    fleet, _, _ = build_fleet(setup, 2, deadline_ms=0.0, clock=clock)
+    fleet.warm()
+    vid = make_videos(1, seed=14)[0]
+    assert fleet.submit("rid", vid, stream=True)
+    fleet.step()
+    first = fleet.pop_stream_chunks()
+    assert first and first[0].request_id == "rid"
+    # Expire it mid-flight: terminal drop, watermark must be released.
+    clock_t[0] = 10.0
+    fleet._replicas[0].engine.deadline_ms = 0.0
+    for rep in fleet._replicas:
+        for res in rep.engine._residents:
+            if res is not None:
+                res.request.deadline = 5.0
+    fleet.step()
+    drops = fleet.pop_dropped()
+    assert [d.request_id for d in drops] == ["rid"]
+    assert "rid" not in fleet._stream_sent
+    # The reused id streams its FULL caption (nothing filtered).
+    assert fleet.submit("rid", vid, stream=True)
+    chunks = []
+    done = []
+    while not fleet.idle:
+        done += fleet.step()
+        chunks += fleet.pop_stream_chunks()
+    comp = next(c for c in done if c.request_id == "rid")
+    got = np.concatenate([np.asarray(c.tokens)
+                          for c in sorted(chunks, key=lambda c: c.seq)])
+    np.testing.assert_array_equal(got, _trim_eos(comp.tokens))
+
+
+def test_submit_during_last_replica_rotation_sheds_not_124(setup):
+    """Review regression: with every routable replica mid-rotation the
+    fleet SHEDS (client retry signal) instead of raising
+    FleetUnrecoverable — the rotation finishes and service resumes."""
+    fleet, _, _ = build_fleet(setup, 1)
+    fleet.warm()
+    vids = make_videos(2, seed=15)
+    assert fleet.submit(0, vids[0])
+    fleet.step()
+    fleet.rotate(0)                    # the only replica is now draining
+    assert fleet.submit(1, vids[1]) is False      # shed, not a raise
+    assert fleet.stats()["fleet"]["fleet_shed"] == 1
+    done = fleet.run_until_idle()      # rotation completes
+    assert [c.request_id for c in done] == [0]
+    assert fleet._replicas[0].in_service
+    assert fleet.submit(1, vids[1])    # service resumed
+    assert len(fleet.run_until_idle()) == 1
+
+
+# -- the fleet health plane through the server -----------------------------
+
+
+def test_server_health_source_renders_fleet_view(setup):
+    fleet, _, _ = build_fleet(setup, 2)
+    fleet.warm()
+    server = CaptionServer(fleet, vocab=None, feats_for=lambda v: None,
+                           health_source=fleet.health)
+    h = server.health_payload()
+    assert h["op"] == "health" and h["status"] == "ok"
+    assert h["replicas"] == 2 and len(h["per_replica"]) == 2
+    assert set(h["fleet"]) == set(FLEET_COUNTERS)
+    # Worst-of-replicas flows through the pluggable source...
+    fleet._replicas[1].engine._note_recovery_event()
+    fleet._update_snapshots()
+    assert server.health_payload()["status"] == "degraded"
+    # ...and the server's own draining state still dominates.
+    server._draining = True
+    assert server.health_payload()["status"] == "draining"
+
+
+# -- bench probe / cache identity / serve_report ---------------------------
+
+
+def test_fleet_probe_parity_and_recompile_contract(setup):
+    from cst_captioning_tpu.serving.bench import serving_probe
+
+    model, variables, _ = setup
+    out = serving_probe(model, variables, [(T, D)], num_requests=8,
+                        rate_hz=500.0, max_len=MAX_LEN, decode_chunk=2,
+                        bucket_sizes=(1, 2), queue_limit=0, seed=11,
+                        replicas=2, kill_replica=0)
+    fleet = out["fleet"]
+    assert fleet["enabled"] and fleet["replicas"] == 2
+    assert fleet["killed_replica"] == 0
+    assert fleet["fleet_replica_kills"] == 1
+    assert fleet["parity_ok"] is True and fleet["parity_mismatches"] == 0
+    assert fleet["answered"] == 8 and out["completed"] == 8
+    assert out["recompiles_after_warmup"] == 0
+    assert len(fleet["per_replica"]) == 2
+    assert out["captions_per_sec"] > 0
+
+
+def test_bench_cache_identity_includes_fleet_axes():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    base = dict(batch_size=2, seq_per_img=2, seq_len=8, vocab=60,
+                hidden=16, bfloat16=0, native_cider=0, decode_chunk=2,
+                scan_unroll=1, decode_kernel="reference", overlap_depth=1,
+                device_rewards=1, stage="serving", serve_requests=8,
+                serve_rate=6.0, serve_buckets="1,4", serve_beam=1,
+                serve_stream=0, serve_cache=0, serve_zipf=0.0,
+                serve_unique=None, serve_cache_compare=0)
+    one = bench.resolved_config(argparse.Namespace(
+        **base, replicas=1, serve_kill_replica=-1))
+    three = bench.resolved_config(argparse.Namespace(
+        **base, replicas=3, serve_kill_replica=1))
+    assert one["replicas"] == 1 and three["replicas"] == 3
+    assert one != three    # fleet and single-engine records never collide
+
+
+def _run_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def _fleet_record(**over):
+    rec = {
+        "metric": "serve_captions_per_sec_per_chip", "value": 100.0,
+        "latency_p50_ms": 5.0, "latency_p99_ms": 9.0, "completed": 8,
+        "num_requests": 8, "shed": 0, "recompiles_after_warmup": 0,
+        "rebuild_recompiles": 0, "platform": "cpu",
+        "fleet": {"enabled": True, "replicas": 2, "fleet_routed": 8,
+                  "fleet_rerouted": 1, "fleet_shed": 0,
+                  "fleet_replica_restarts": 1, "fleet_replica_kills": 1,
+                  "killed_replica": 0, "parity_ok": True,
+                  "parity_mismatches": 0,
+                  "per_replica": [
+                      {"replica": 0, "status": "ok", "completed": 4,
+                       "restarts": 1, "kills": 1},
+                      {"replica": 1, "status": "ok", "completed": 4,
+                       "restarts": 0, "kills": 0}]},
+    }
+    rec["fleet"].update(over)
+    return rec
+
+
+def test_serve_report_renders_fleet_rows(tmp_path):
+    proc = _run_report(_fleet_record(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "captions/s/fleet" in proc.stdout
+    assert "replica 0" in proc.stdout and "replica 1" in proc.stdout
+    assert "parity_ok=True" in proc.stdout
+
+
+def test_serve_report_gates_on_fleet_parity(tmp_path):
+    proc = _run_report(_fleet_record(parity_ok=False,
+                                     parity_mismatches=2), tmp_path)
+    assert proc.returncode == 1
+    assert "bit-identical" in proc.stderr
+
+
+def test_serve_report_old_records_render_unchanged(tmp_path):
+    rec = {"metric": "serve_captions_per_sec_per_chip", "value": 50.0,
+           "latency_p50_ms": 4.0, "latency_p99_ms": 8.0,
+           "recompiles_after_warmup": 0, "platform": "cpu"}
+    proc = _run_report(rec, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "captions/s " in proc.stdout or "captions/s\n" in proc.stdout
+    assert "fleet" not in proc.stdout
+
+
+# -- doc pins --------------------------------------------------------------
+
+
+def test_serving_doc_pins_fleet_counter_table():
+    with open(os.path.join(REPO, "SERVING.md")) as f:
+        text = f.read()
+    for name in FLEET_COUNTERS:
+        assert name in text, f"SERVING.md fleet table missing {name}"
+    for token in ("worst-of-replicas", "rotate", "serve_fleet.py",
+                  "--replicas", "serve-fleet-chaos"):
+        assert token in text, f"SERVING.md Fleet section missing {token!r}"
+
+
+def test_resilience_doc_pins_replica_axis():
+    with open(os.path.join(REPO, "RESILIENCE.md")) as f:
+        text = f.read()
+    assert "kind@replica=K" in text
+    assert "for_replica" in text
+
+
+# -- slow subprocess drill (make serve-fleet-chaos) ------------------------
+
+
+@pytest.mark.slow
+def test_cli_fleet_demo_under_replica_fault():
+    """scripts/serve_fleet.py end to end: demo fleet of 2 under a
+    replica-targeted wedge — every id answered, exit 0, fleet stats on
+    stderr with the restart visible."""
+    reqs = "".join(json.dumps({"id": i, "video_id": f"v{i}"}) + "\n"
+                   for i in range(6)) + json.dumps({"op": "health"}) + "\n"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_fleet.py"),
+         "--serve_demo", "1", "--serve_replicas", "2",
+         "--serve_demo_eos_bias", "-4",
+         "--serve_retry_limit", "0", "--serve_rebuild_limit", "0",
+         "--fault_plan", "serve_wedge@replica=0",
+         "--loglevel", "WARNING"],
+        input=reqs, capture_output=True, text=True, timeout=600,
+        cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    answered = {l["id"] for l in lines if "caption" in l}
+    assert answered == set(range(6))
+    health = [l for l in lines if l.get("op") == "health"]
+    assert health and health[0]["replicas"] == 2
+    stats_line = [l for l in proc.stderr.splitlines()
+                  if l.startswith("serve_fleet: {")]
+    assert stats_line, proc.stderr[-2000:]
+    stats = json.loads(stats_line[0][len("serve_fleet: "):])
+    assert stats["fleet"]["fleet_replica_restarts"] == 1
+    assert stats["completed"] == 6
