@@ -34,7 +34,8 @@ TRAIN_COMMON = \
 .PHONY: test lint lint-json chaos xe wxe cst cst_scb cst_host eval bench \
         demo trace-demo scale_chain report collect chip_window tune \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
-        serve-chaos serve-fleet-bench serve-fleet-chaos bf16-parity clean
+        serve-chaos serve-fleet-bench serve-fleet-chaos serve-trace-demo \
+        bf16-parity clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -255,6 +256,38 @@ serve-fleet-bench:
 serve-fleet-chaos:
 	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
 	  $(PY) -m pytest tests/test_serving_fleet.py -q
+	JAX_PLATFORMS=cpu $(PY) bench.py --stage serving --platform cpu --cache 0 \
+	  --batch_size 8 --seq_per_img 2 --seq_len 16 --vocab 500 --hidden 64 \
+	  --serve_requests 24 --serve_rate 200 --replicas 3 \
+	  --serve_kill_replica 1 --probe_eos_bias -2 \
+	  --serve_trace 1 \
+	  --serve_blackbox /tmp/cst_serve_fleet_chaos_blackbox.json \
+	  > /tmp/cst_serve_fleet_chaos.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_fleet_chaos.json
+
+# Zero-setup request-lifecycle drill (OBSERVABILITY.md "Request
+# lifecycle & flight recorder"): pipe a few requests (plus the
+# {"op": "stats"} and {"op": "dump"} wire ops) through the demo backend
+# with span tracing AND the lifecycle tracer armed, then render the
+# per-request waterfall — the Chrome trace's async request tracks plus
+# the duration spans — with trace_report.  Artifacts: the Perfetto-
+# loadable trace_*.json, blackbox.json (on-demand dump), and the
+# telemetry.json exit snapshot, all under /tmp/cst_serve_trace_demo.
+serve-trace-demo:
+	rm -rf /tmp/cst_serve_trace_demo && mkdir -p /tmp/cst_serve_trace_demo
+	printf '%s\n' \
+	  '{"id": 1, "video_id": "v0"}' \
+	  '{"id": 2, "video_id": "v1"}' \
+	  '{"id": 3, "video_id": "v2"}' \
+	  '{"op": "stats"}' \
+	  '{"op": "dump"}' \
+	| JAX_PLATFORMS=cpu $(PY) scripts/serve.py --serve_demo 1 --beam_size 1 \
+	  --trace_dir /tmp/cst_serve_trace_demo/trace \
+	  --serve_blackbox /tmp/cst_serve_trace_demo/blackbox.json \
+	  --serve_telemetry_file /tmp/cst_serve_trace_demo/telemetry.json
+	$(PY) scripts/trace_report.py \
+	  --trace_dir /tmp/cst_serve_trace_demo/trace \
+	  --json /tmp/cst_serve_trace_demo/trace_summary.json
 
 # -- zero-setup synthetic demo --------------------------------------------
 

@@ -483,6 +483,8 @@ def bench_serving(args) -> dict:
         zipf_alpha=args.serve_zipf,
         replicas=args.replicas,
         kill_replica=args.serve_kill_replica,
+        lifecycle=bool(args.serve_trace or args.serve_blackbox),
+        blackbox_path=args.serve_blackbox,
     )
     shapes = [(28, 2048), (1, 4096)]
     if args.serve_cache_compare and args.serve_cache:
@@ -496,9 +498,11 @@ def bench_serving(args) -> dict:
         # serve_report renders both and exits 1 when it doesn't.
         serving_probe(model, {"params": params}, shapes,
                       **{**probe_kw, "cache_size": 0, "num_requests": 8,
-                         "rate_hz": min(args.serve_rate, 100.0)})
+                         "rate_hz": min(args.serve_rate, 100.0),
+                         "blackbox_path": None})
         twin = serving_probe(model, {"params": params}, shapes,
-                             **{**probe_kw, "cache_size": 0})
+                             **{**probe_kw, "cache_size": 0,
+                                "blackbox_path": None})
         out = serving_probe(model, {"params": params}, shapes, **probe_kw)
         out["cache_off_captions_per_sec"] = twin["captions_per_sec"]
         out["cache_off_latency_p50_ms"] = twin["latency_p50_ms"]
@@ -609,6 +613,19 @@ def parse_args():
                         "at the same seed in the same bench run and "
                         "report cache_off_captions_per_sec / "
                         "cache_speedup (requires --serve_cache > 0)")
+    p.add_argument("--serve_trace", type=int, default=0,
+                   help="--stage serving: 1 = arm the request-lifecycle "
+                        "tracing plane (telemetry/lifecycle.py) — the "
+                        "JSON line gains the terminal-accounting record "
+                        "and the per-request latency attribution "
+                        "(queue_wait/admit/decode/recovery/requeue "
+                        "p50/p99, per replica), both gated by "
+                        "scripts/serve_report.py.  0 (default) = "
+                        "disarmed, the overhead-free measurement mode")
+    p.add_argument("--serve_blackbox", default=None,
+                   help="--stage serving: write the flight recorder's "
+                        "blackbox.json here at probe end (implies "
+                        "--serve_trace 1)")
     p.add_argument("--probe_eos_bias", type=float, default=10.0,
                    help="EOS-logit bias for the rollout step-count probe "
                         "(simulates a converged policy's early "
@@ -706,6 +723,12 @@ def resolved_config(args) -> dict:
         # a cache entry with a single-engine record.
         config["replicas"] = args.replicas
         config["serve_kill_replica"] = args.serve_kill_replica
+        # Lifecycle tracing adds per-event host work to the measured
+        # path: a traced record and an untraced one are different
+        # measurement protocols and must not share a cache entry.
+        config["serve_trace"] = int(bool(
+            getattr(args, "serve_trace", 0)
+            or getattr(args, "serve_blackbox", None)))
     return config
 
 

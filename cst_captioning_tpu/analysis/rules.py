@@ -30,6 +30,7 @@ HOT_PATHS = (
     "cst_captioning_tpu/serving/engine.py",
     "cst_captioning_tpu/serving/server.py",
     "cst_captioning_tpu/serving/fleet.py",
+    "cst_captioning_tpu/telemetry/lifecycle.py",
     "cst_captioning_tpu/parallel/",
 )
 

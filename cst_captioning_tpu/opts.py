@@ -468,8 +468,40 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "heartbeat.json here (watchdog discipline: "
                         "atomic, fsync'd) carrying the serving health "
                         "payload — status, queue depth, recovery "
-                        "counters — once per second, plus the hard "
+                        "counters, and in fleet mode the per_replica "
+                        "breakdown — once per second, plus the hard "
                         "wedge kill when --wedge_timeout is set")
+    g.add_argument("--serve_lifecycle", type=int, default=1,
+                   help="1 (default) = arm the request-lifecycle tracing "
+                        "plane (telemetry/lifecycle.py): every request's "
+                        "journey (received/queued/routed/admitted/decode "
+                        "chunks/recovery/requeue/terminal) lands in a "
+                        "bounded in-memory flight recorder, the "
+                        "{'op': 'stats'} view gains per-request latency "
+                        "attribution, and the {'op': 'dump'} wire op / "
+                        "exit-124 path / hard-abort drain write "
+                        "blackbox.json (OBSERVABILITY.md 'Request "
+                        "lifecycle & flight recorder').  0 = every hook "
+                        "disarmed at one is-None check")
+    g.add_argument("--serve_lifecycle_events",
+                   type=_positive_int("--serve_lifecycle_events"),
+                   default=4096,
+                   help="flight-recorder ring capacity (events): fixed "
+                        "host memory holding the last-N lifecycle "
+                        "events the blackbox dumps")
+    g.add_argument("--serve_blackbox", default="blackbox.json",
+                   help="where the flight recorder writes its forensic "
+                        "blackbox.json (atomic): on ServingUnrecoverable/"
+                        "FleetUnrecoverable (exit 124), on a hard-abort "
+                        "drain, and on the {'op': 'dump'} wire op.  "
+                        "Empty = never write")
+    g.add_argument("--serve_telemetry_file", default=None,
+                   help="write the registry's atomic telemetry.json exit "
+                        "snapshot here on drain/exit (the train.py "
+                        "discipline, so serving chaos drills leave the "
+                        "same machine-auditable artifact).  Default: "
+                        "<checkpoint_path>/telemetry.json in checkpoint "
+                        "mode, off in demo mode")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:

@@ -51,6 +51,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry.lifecycle import LifecycleTracer
 from .buckets import DEFAULT_BUCKETS, ProgramCache
 from .cache import ResultCache
 from .engine import ServingEngine, _trim_eos
@@ -88,6 +89,8 @@ def serving_probe(model, variables, feat_shapes: Sequence,
                   unique_videos: Optional[int] = None,
                   zipf_alpha: float = 0.0,
                   replicas: int = 1, kill_replica: int = -1,
+                  lifecycle: bool = False,
+                  blackbox_path: Optional[str] = None,
                   registry=None, tracer=None,
                   clock=time.perf_counter) -> Dict[str, Any]:
     """Drive one engine through a seeded Poisson load; -> metrics dict.
@@ -111,20 +114,33 @@ def serving_probe(model, variables, feat_shapes: Sequence,
     cache = ResultCache(int(cache_size)) if cache_size else None
     fleet_n = max(1, int(replicas))
     programs = ProgramCache(registry)   # shared across replicas/restarts
+    # The request-lifecycle tracing plane (telemetry/lifecycle.py): the
+    # probe's measured-latency twin — per-request attribution must
+    # reconcile with the probe's own completion latencies, so the
+    # tracer shares the probe clock.  Disarmed (the default), nothing
+    # below pays more than an is-None check per hook — the "no caps/s
+    # regression" mode the bench line is normally measured in.
+    recorder = (LifecycleTracer(clock=clock, tracer=tracer,
+                                registry=registry)
+                if lifecycle or blackbox_path else None)
 
     def build_engine(_k=0):
+        lc = None
+        if recorder is not None:
+            lc = (recorder.for_replica(_k) if fleet_n > 1
+                  else recorder)
         return ServingEngine(
             model, variables, feat_shapes, max_len=max_len,
             beam_size=beam_size, length_norm=length_norm,
             decode_chunk=decode_chunk, bucket_sizes=bucket_sizes,
             queue_limit=queue_limit, result_cache=cache,
-            program_cache=programs,
+            program_cache=programs, lifecycle=lc,
             registry=registry, tracer=tracer, clock=clock)
 
     if fleet_n > 1:
         from .fleet import FleetRouter
 
-        engine = FleetRouter(build_engine, fleet_n,
+        engine = FleetRouter(build_engine, fleet_n, lifecycle=recorder,
                              registry=registry, clock=clock)
     else:
         engine = build_engine()
@@ -278,6 +294,31 @@ def serving_probe(model, variables, feat_shapes: Sequence,
             "per_replica": st["per_replica"],
         })
 
+    lifecycle_out: Dict[str, Any] = {"enabled": recorder is not None}
+    attribution: Optional[Dict[str, Any]] = None
+    if recorder is not None:
+        # Terminal accounting (every submitted id reaches exactly one
+        # terminal event) + per-request attribution reconciled against
+        # the engine's measured latencies — serve_report exits 1 on
+        # either gate failing (the ISSUE-14 acceptance checks).
+        attribution = recorder.attribution_report()
+        lifecycle_out.update({
+            "events": recorder.emitted(),
+            "retained": len(recorder.events()),
+            **recorder.accounting(),
+        })
+        if blackbox_path:
+            recorder.attach(
+                health=engine.health,
+                # The flat counter map — the documented blackbox shape
+                # (SERVING.md schema 1), same as the serving front ends.
+                counters=((lambda: registry.snapshot().get("counters"))
+                          if registry is not None else None),
+                program_cache=lambda: {"builds": programs.builds,
+                                       "entries": len(programs)})
+            recorder.dump(blackbox_path, reason="probe_end")
+            lifecycle_out["blackbox"] = str(blackbox_path)
+
     lat_ms = np.asarray(sorted(latencies.values())) * 1e3
     pct = (lambda q: round(float(np.percentile(lat_ms, q)), 3)
            if lat_ms.size else None)
@@ -306,6 +347,12 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "max_len": int(max_len),
         "stream": stream_out,
         "cache": cache_out,
+        # Request-lifecycle record (telemetry/lifecycle.py): terminal
+        # accounting + (when armed) the latency-attribution components;
+        # serve_report gates on both.
+        "lifecycle": lifecycle_out,
+        **({"attribution": attribution} if attribution is not None
+           else {}),
         # Fleet record (serve_report renders per-replica rows and gates
         # on parity_ok; absent/disabled on single-engine probes so old
         # records keep their exact shape).

@@ -276,7 +276,7 @@ class ServingEngine:
                  degraded_window_s: float = 60.0,
                  result_cache: Optional[ResultCache] = None,
                  program_cache: Optional[ProgramCache] = None,
-                 registry=None, tracer=None,
+                 registry=None, tracer=None, lifecycle=None,
                  clock: Callable[[], float] = time.monotonic):
         if getattr(model, "decoder_type", "lstm") != "lstm":
             raise ValueError(
@@ -309,6 +309,10 @@ class ServingEngine:
         self.degraded_window_s = float(degraded_window_s)
         self._registry = registry
         self._tracer = tracer
+        # Request-lifecycle tracing plane (telemetry/lifecycle.py): a
+        # LifecycleTracer, a fleet replica's labeled view of one, or
+        # None (the default — every hook below is one is-None check).
+        self._lifecycle = lifecycle
         self.clock = clock
 
         # ``program_cache`` may be SHARED across engines (the fleet
@@ -596,7 +600,9 @@ class ServingEngine:
                meta: Optional[dict] = None,
                deadline_ms: Optional[float] = None,
                stream: bool = False,
-               no_cache: bool = False) -> bool:
+               no_cache: bool = False,
+               _requeued: bool = False,
+               _arrival: Optional[float] = None) -> bool:
         """Queue one request.  Returns False (sheds) when the bounded
         queue is full — the engine's backpressure signal; the front end
         turns it into an explicit reject response.  ``deadline_ms``
@@ -604,7 +610,11 @@ class ServingEngine:
         the default; 0 = explicitly no deadline).  ``stream`` emits
         per-chunk :class:`StreamChunk` records (``pop_stream_chunks``);
         ``no_cache`` skips the exact-result cache for this request
-        (counted as ``serve_cache_bypass`` — the drill's miss twin)."""
+        (counted as ``serve_cache_bypass`` — the drill's miss twin).
+        ``_requeued``/``_arrival`` are the fleet ``requeue`` internals:
+        the lifecycle stream records a re-entry instead of a fresh
+        intake, and the request keeps its ORIGINAL arrival clock so its
+        latency never under-reports across a replica restart."""
         self._submitted += 1
         index = self._submitted - 1        # submission ordinal (@req=N)
         self._inc("serve_requests")
@@ -614,7 +624,16 @@ class ServingEngine:
             raise ValueError(
                 f"request {request_id!r} feature shapes {shapes} do not "
                 f"match the engine's compiled geometry {self._feat_shapes}")
-        arrival = self.clock()
+        arrival = self.clock() if _arrival is None else float(_arrival)
+        if self._lifecycle is not None:
+            # "received" is stamped at the arrival clock so the event
+            # stream reconciles with the engine's latency bookkeeping;
+            # a re-entry after a replica kill/rotation is "requeued",
+            # stamped NOW (its arrival is the original submission's).
+            if _requeued:
+                self._lifecycle.emit("requeued", request_id)
+            else:
+                self._lifecycle.emit("received", request_id, ts=arrival)
         # Exact-result cache, IN FRONT of admission (and of the bounded
         # queue: a hit consumes no slot, no queue depth, no decode — it
         # would be self-defeating to shed one).
@@ -653,6 +672,11 @@ class ServingEngine:
         if self.queue_limit and len(self._queue) >= self.queue_limit:
             self._shed += 1
             self._inc("serve_shed")
+            if self._lifecycle is not None:
+                # Terminal on a standalone engine; a fleet replica's
+                # labeled view drops this — the router may still place
+                # the request elsewhere and owns the fleet-edge shed.
+                self._lifecycle.emit("shed", request_id, where="queue")
             self._update_gauges()
             return False
         # NOTE: a lookup that found nothing is NOT counted a miss here —
@@ -669,6 +693,9 @@ class ServingEngine:
                                    stream=bool(stream),
                                    no_cache=bool(no_cache),
                                    cache_key=cache_key))
+        if self._lifecycle is not None:
+            self._lifecycle.emit("queued", request_id,
+                                 depth=len(self._queue))
         self._update_gauges()
         return True
 
@@ -702,11 +729,22 @@ class ServingEngine:
         self._inc("serve_completed")
         self._latencies.append(comp.latency_s)
         self._observe("serve_request_latency_ms", comp.latency_s * 1e3)
+        if self._lifecycle is not None:
+            self._lifecycle.emit("cache_hit", request_id, ts=now)
+            self._lifecycle.emit("completed", request_id, ts=now,
+                                 latency_ms=round(comp.latency_s * 1e3, 3),
+                                 cached=True)
 
     @property
     def idle(self) -> bool:
         return (not self._queue and not any(self._residents)
                 and not self._hits)
+
+    @property
+    def program_cache(self) -> ProgramCache:
+        """The (possibly shared) compile-once cache — read-only surface
+        for the flight recorder's ProgramCache-state provider."""
+        return self._cache
 
     @property
     def resident_count(self) -> int:
@@ -799,30 +837,24 @@ class ServingEngine:
             remaining_ms = max((req.deadline - self.clock()) * 1e3, 1e-3)
         else:
             remaining_ms = 0.0
-        ok = self.submit(req.request_id, req.feats, meta=req.meta,
-                         deadline_ms=remaining_ms, stream=req.stream,
-                         no_cache=req.no_cache)
-        if ok:
-            if self._queue and \
-                    self._queue[-1].request_id == req.request_id:
-                self._queue[-1].arrival = req.arrival
-            elif self._hits and \
-                    self._hits[-1].request_id == req.request_id:
-                # The re-submission completed instantly as a shared-
-                # cache hit: restore the ORIGINAL arrival there too, so
-                # a request that waited through a replica restart never
-                # under-reports its latency.
-                hit = self._hits[-1]
-                hit.latency_s = hit.done_at - req.arrival
-                if self._latencies:
-                    self._latencies[-1] = hit.latency_s
-        return ok
+        # ``_arrival`` carries the ORIGINAL submission clock straight
+        # into the new Request (and into a shared-cache hit's latency),
+        # so a request that waited through a replica restart never
+        # under-reports; ``_requeued`` makes the lifecycle stream record
+        # a re-entry instead of a fresh intake.
+        return self.submit(req.request_id, req.feats, meta=req.meta,
+                           deadline_ms=remaining_ms, stream=req.stream,
+                           no_cache=req.no_cache,
+                           _requeued=True, _arrival=req.arrival)
 
     # -- deadlines ---------------------------------------------------------
 
     def _drop(self, req: Request, reason: str, where: str) -> None:
         self._dropped.append(Dropped(req.request_id, reason, where,
                                      deadline=req.deadline, meta=req.meta))
+        if self._lifecycle is not None:
+            self._lifecycle.emit("dropped", req.request_id,
+                                 reason=reason, where=where)
         if reason == "expired":
             self._expired += 1
             self._inc("serve_expired")
@@ -969,6 +1001,12 @@ class ServingEngine:
                                               admit_at=self.clock())
             self._inc("serve_admitted")
             self._observe("serve_admit_ms", admit_ms)
+            if self._lifecycle is not None:
+                # admit_ms rides on the event so attribution can carve
+                # the encoder pass out of the queue-wait interval.
+                self._lifecycle.emit("admitted", req.request_id,
+                                     slot=slot,
+                                     admit_ms=round(admit_ms, 3))
 
     def _dispatch_chunk(self, programs) -> Tuple[np.ndarray, np.ndarray,
                                                  Optional[np.ndarray]]:
@@ -1058,6 +1096,14 @@ class ServingEngine:
                 attempts += 1
                 self._inc("serve_chunk_retries")
                 self._chunk_retries += 1
+                if self._lifecycle is not None:
+                    # Every resident aboard pays the failed dispatch:
+                    # the retry lands in each one's recovery component.
+                    for res in self._residents:
+                        if res is not None:
+                            self._lifecycle.emit(
+                                "retry", res.request.request_id,
+                                attempt=attempts, error=type(e).__name__)
                 log.warning("serving chunk failed (%s); deterministic "
                             "re-run %d/%d", e, attempts,
                             max(self.retry_limit, 1))
@@ -1098,6 +1144,9 @@ class ServingEngine:
             feats = [jnp.asarray(f[None]) for f in res.request.feats]
             self._dev = programs["admit"](self._variables, self._dev,
                                           feats, slot)
+            if self._lifecycle is not None:
+                self._lifecycle.emit("rebuild", res.request.request_id,
+                                     slot=slot, rebuild=self._rebuilds)
         delta = self._cache.builds - builds0
         if delta:
             self._rebuild_recompiles += delta
@@ -1135,6 +1184,10 @@ class ServingEngine:
             if pars is not None:
                 res.pars.append(pars[slot])
             res.steps += self.chunk
+            if self._lifecycle is not None:
+                self._lifecycle.emit("decode_chunk",
+                                     res.request.request_id,
+                                     k=res.steps // self.chunk, slot=slot)
             if res.request.stream and k == 1:
                 # Greedy streams honestly: this chunk's emitted tokens
                 # are final the moment they leave the device.  (Beam
@@ -1272,6 +1325,11 @@ class ServingEngine:
         if res.request.deadline is not None:
             self._observe("serve_deadline_slack_ms",
                           (res.request.deadline - now) * 1e3)
+        if self._lifecycle is not None:
+            self._lifecycle.emit("completed", comp.request_id, ts=now,
+                                 latency_ms=round(comp.latency_s * 1e3, 3),
+                                 slot=slot,
+                                 decode_steps=comp.decode_steps)
         return comp
 
     def drain(self, abort: Optional[Callable[[], bool]] = None
@@ -1288,6 +1346,11 @@ class ServingEngine:
         if rejected:
             self._rejected += len(rejected)
             self._inc("serve_rejected_drain", len(rejected))
+            if self._lifecycle is not None:
+                for req in rejected:
+                    self._lifecycle.emit("dropped", req.request_id,
+                                         reason="rejected_draining",
+                                         where="drain")
         done: List[Completion] = list(self._hits)  # cache hits owe nothing
         self._hits.clear()
         while any(r is not None for r in self._residents):
@@ -1330,7 +1393,7 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         lat = np.asarray(self._latencies, np.float64) * 1e3
         pct = (lambda q: float(np.percentile(lat, q)) if lat.size else None)
-        return {
+        out = {
             "slots": self._slots_n,
             "buckets": list(self.buckets),
             "beam_size": self.beam_size,
@@ -1352,6 +1415,15 @@ class ServingEngine:
             **self.cache_counters(),
             **self.stream_stats(),
         }
+        # Per-request latency attribution (telemetry/lifecycle.py): a
+        # standalone engine holds the base tracer and reports the
+        # component percentiles here; a fleet replica holds a labeled
+        # view (no report surface) and the ROUTER's stats carry the
+        # fleet-wide + per-replica breakdown instead.
+        if self._lifecycle is not None and \
+                hasattr(self._lifecycle, "attribution_report"):
+            out["attribution"] = self._lifecycle.attribution_report()
+        return out
 
     def cache_counters(self) -> Dict[str, Any]:
         """The ONE definition of the result-cache audit view (the

@@ -173,7 +173,7 @@ class FleetRouter:
 
     def __init__(self, engine_factory: Callable[[int], ServingEngine],
                  replicas: int, *, devices: Optional[Sequence] = None,
-                 restart_limit: int = 3, registry=None,
+                 restart_limit: int = 3, registry=None, lifecycle=None,
                  clock: Callable[[], float] = time.monotonic):
         n = int(replicas)
         if n < 1:
@@ -182,6 +182,14 @@ class FleetRouter:
                 else [devices[k % len(devices)] for k in range(n)])
         self.restart_limit = max(0, int(restart_limit))
         self._registry = registry
+        # The fleet-wide request-lifecycle tracer (telemetry/
+        # lifecycle.py, the BASE object): the router owns the intake
+        # events (received / routed / fleet-edge shed+drop / killed);
+        # replica engines hold `lifecycle.for_replica(k)` labeled views
+        # — the caller bakes those into `engine_factory` the same way
+        # it bakes the shared caches.  None = untraced (one is-None
+        # check per hook).
+        self._lifecycle = lifecycle
         self.clock = clock
         # Single-owner scheduler state (the module-docstring contract).
         self._replicas: List[Replica] = [  # cstlint: owned_by=scheduler
@@ -237,6 +245,12 @@ class FleetRouter:
         """Route one request.  True = accepted somewhere (or answered at
         the fleet edge via a drop record); False = every candidate's
         bounded queue shed it — the fleet-wide backpressure signal."""
+        if self._lifecycle is not None:
+            # The ROUTER is the fleet's intake: replica engines carry
+            # labeled views that drop received/shed (lifecycle.py), so
+            # one fleet request is exactly one "received" no matter how
+            # many candidates were tried.
+            self._lifecycle.emit("received", request_id)
         cands = self._candidates()
         if not cands:
             if any(r.in_service or r.draining for r in self._replicas):
@@ -246,6 +260,9 @@ class FleetRouter:
                 # finish and service resumes.
                 self._fleet_shed += 1
                 self._inc("fleet_shed")
+                if self._lifecycle is not None:
+                    self._lifecycle.emit("shed", request_id,
+                                         where="fleet")
                 return False
             raise FleetUnrecoverable(
                 "every replica is dead (per-replica restart budget "
@@ -267,6 +284,10 @@ class FleetRouter:
                 self._inc("fleet_shed")
                 self._dropped.append(Dropped(request_id, "deadline_shed",
                                              "fleet", meta=meta))
+                if self._lifecycle is not None:
+                    self._lifecycle.emit("dropped", request_id,
+                                         reason="deadline_shed",
+                                         where="fleet")
                 return True
         for i, rep in enumerate(cands):
             with rep.on_device():
@@ -279,9 +300,15 @@ class FleetRouter:
                 if i:
                     self._rerouted += 1
                     self._inc("fleet_rerouted")
+                if self._lifecycle is not None:
+                    self._lifecycle.emit("routed", request_id,
+                                         replica=rep.index,
+                                         candidate=i)
                 return True
         self._fleet_shed += 1
         self._inc("fleet_shed")
+        if self._lifecycle is not None:
+            self._lifecycle.emit("shed", request_id, where="fleet")
         return False
 
     # -- lifecycle ---------------------------------------------------------
@@ -330,6 +357,13 @@ class FleetRouter:
         rep.completed_prior = rep.completed_total()
         self._collect(rep)               # drops/chunks it already owed
         done, reqs = rep.engine.evacuate()
+        if self._lifecycle is not None:
+            # Every evacuated request was aboard when the replica died:
+            # the kill starts its "requeue" attribution window and is
+            # the kill→requeue→responded chain the chaos drill pins.
+            for req in reqs:
+                self._lifecycle.emit("killed", req.request_id,
+                                     replica=rep.index)
         self._evac_done.extend(done)
         # A dead replica is not draining: a zombie draining flag would
         # keep the all-dead check below (and ``idle``) from ever firing.
@@ -379,6 +413,9 @@ class FleetRouter:
             self._stream_forget(req.request_id)   # terminal answer
             self._dropped.append(Dropped(req.request_id, "admit_failed",
                                          "fleet", meta=req.meta))
+            if self._lifecycle is not None:
+                self._lifecycle.emit("dropped", req.request_id,
+                                     reason="admit_failed", where="fleet")
 
     def _finish_rotation(self, rep: Replica) -> None:
         """The drained replica's warm rebuild: fresh engine through the
@@ -617,7 +654,7 @@ class FleetRouter:
         lat = np.asarray([x for e in engines for x in e.latency_window_s()],
                          np.float64) * 1e3
         pct = (lambda q: float(np.percentile(lat, q)) if lat.size else None)
-        return {
+        out = {
             "replicas": len(self._replicas),
             "in_service": sum(1 for r in self._replicas if r.in_service),
             "slots": sum(s["slots"] for s in estats),
@@ -644,6 +681,13 @@ class FleetRouter:
             **self.cache_counters(),
             **self.stream_stats(),
         }
+        if self._lifecycle is not None:
+            # Fleet-wide latency attribution + the per-replica
+            # component breakdown (requests grouped by the replica that
+            # COMPLETED them — a requeued request counts at its final
+            # owner, where its whole story ended).
+            out["attribution"] = self._lifecycle.attribution_report()
+        return out
 
     def per_replica(self) -> List[Dict[str, Any]]:
         """Per-replica rows for serve_report / the bench line, from the

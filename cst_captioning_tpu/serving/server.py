@@ -16,6 +16,15 @@ Protocol (one JSON object per line, either direction):
              the final caption (SERVING.md "Streaming & result cache")
   health:    {"op": "health", "status": "ok"|"degraded"|"draining",
               "queue_depth", "residents", "recovery": {...}}
+  stats:     {"op": "stats", ...engine/fleet stats()...} — the full
+             scheduler statistics view, including the per-request
+             latency-attribution report when the lifecycle tracer is
+             armed (SERVING.md "Wire format")
+  dump:      {"op": "dump"} -> the flight recorder writes blackbox.json
+             (atomic) and answers {"op": "dump", "path", "events",
+             "emitted"}; "path" in the request overrides the configured
+             target.  Errors: "no_recorder" (tracing disarmed),
+             "no_path" (nowhere configured to write)
   reject:    {"id", "error": "shed" | "bad_request" | "unknown_video"
                             | "unknown_op" | "rejected_draining"
                             | "expired" | "admit_failed", ...}
@@ -97,7 +106,8 @@ class CaptionServer:
 
     def __init__(self, engine: ServingEngine, vocab, feats_for,
                  *, handler=None, out=None, idle_sleep: float = 0.002,
-                 watchdog=None, registry=None, health_source=None):
+                 watchdog=None, registry=None, health_source=None,
+                 lifecycle=None, blackbox_path=None):
         # The engine is single-owner state: reader threads parse lines
         # into the inbox, ONLY the scheduler loop may touch the engine
         # (cstlint:thread-ownership — the inbox-owns-intake discipline).
@@ -110,8 +120,15 @@ class CaptionServer:
         self.watchdog = watchdog
         self.registry = registry
         self._health_source = health_source
+        # Request-lifecycle tracing (telemetry/lifecycle.py): the BASE
+        # tracer — the server stamps the terminal "responded" events and
+        # owns the {"op": "dump"} flight-recorder wire op, writing the
+        # blackbox to ``blackbox_path``.  None = untraced.
+        self._lifecycle = lifecycle
+        self.blackbox_path = blackbox_path
         if registry is not None:
-            registry.declare("serve_bad_lines", "serve_health_queries")
+            registry.declare("serve_bad_lines", "serve_health_queries",
+                             "serve_stats_queries", "serve_dump_queries")
         self._inbox: "queue.Queue" = queue.Queue()
         self._eof = threading.Event()
         self._write_lock = named_lock("serving.server.write")
@@ -159,6 +176,9 @@ class CaptionServer:
             if comp.ttft_s is not None:
                 obj["ttft_ms"] = round(comp.ttft_s * 1e3, 3)
         self._write(respond, obj)
+        if self._lifecycle is not None:
+            self._lifecycle.emit("responded", comp.request_id,
+                                 status="ok")
 
     def _respond_stream_chunk(self, chunk: StreamChunk) -> None:
         meta = chunk.meta or {}
@@ -199,6 +219,9 @@ class CaptionServer:
         elif drop.reason == "admit_failed" and drop.where == "fleet":
             obj["where"] = "fleet"
         self._write(respond, obj)
+        if self._lifecycle is not None:
+            self._lifecycle.emit("responded", drop.request_id,
+                                 status=obj["error"])
 
     def _respond_dropped_all(self) -> bool:
         drops = self.engine.pop_dropped()
@@ -283,12 +306,43 @@ class CaptionServer:
                 self.registry.inc("serve_health_queries")
             self._write(respond, self.health_payload())
             return
+        if op == "stats":
+            # The scheduler-statistics wire op: the same stats() dict
+            # the exit line prints, latency attribution included when
+            # the lifecycle tracer is armed (SERVING.md "Wire format").
+            if self.registry is not None:
+                self.registry.inc("serve_stats_queries")
+            self._write(respond, {"op": "stats", **self.engine.stats()})
+            return
+        if op == "dump":
+            # On-demand flight-recorder dump: write blackbox.json NOW
+            # (atomic_json_write) and answer with where it landed —
+            # the operator's live forensic snapshot.
+            if self.registry is not None:
+                self.registry.inc("serve_dump_queries")
+            if self._lifecycle is None:
+                self._write(respond, {"op": "dump", "error": "no_recorder",
+                                      "detail": "lifecycle tracing is "
+                                                "disarmed"})
+                return
+            path = req.get("path") or self.blackbox_path
+            if not path:
+                self._write(respond, {"op": "dump", "error": "no_path",
+                                      "detail": "no blackbox path "
+                                                "configured or supplied"})
+                return
+            doc = self._lifecycle.dump(path, reason="wire_dump")
+            self._write(respond, {"op": "dump", "path": str(path),
+                                  "events": doc["events_retained"],
+                                  "emitted": doc["events_emitted"]})
+            return
         if op not in ("caption", "stream"):
             self._count_bad_line()
             self._write(respond, {"id": req.get("id"), "error": "unknown_op",
                                   "op": op,
                                   "detail": "expected op 'caption', "
-                                            "'stream' or 'health'"})
+                                            "'stream', 'health', 'stats' "
+                                            "or 'dump'"})
             return
         stream = (op == "stream")
         if stream and self.engine.chunk >= self.engine.max_len:
@@ -335,10 +389,16 @@ class CaptionServer:
                                   "detail": str(e)})
             return
         if not ok:
+            # queue_depth via the cheap property, NOT stats(): with the
+            # lifecycle tracer armed stats() walks the whole event ring,
+            # and sheds happen exactly when the scheduler is saturated.
             self._write(respond, self._mark_stream_terminal(
                 {"id": rid, "error": "shed", "video_id": vid,
-                 "queue_depth": self.engine.stats()["queue_depth"]},
+                 "queue_depth": self.engine.queue_depth},
                 stream))
+            if self._lifecycle is not None:
+                self._lifecycle.emit("responded", (rid, vid),
+                                     status="shed")
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -368,7 +428,8 @@ class CaptionServer:
         # are rejected like the queued ones, so a client correlating ids
         # never waits on a caption that will not come.
         abandoned = self.engine.resident_requests()
-        for req in rejected + abandoned:
+        for req, was_resident in ([(r, False) for r in rejected]
+                                  + [(r, True) for r in abandoned]):
             meta = req.meta or {}
             self._write(meta.get("respond", self._stdout_respond),
                         self._mark_stream_terminal(
@@ -376,6 +437,23 @@ class CaptionServer:
                              "video_id": meta.get("video_id"),
                              "error": "rejected_draining"},
                             meta.get("stream")))
+            if self._lifecycle is not None:
+                # The abandoned residents' terminal: the engine never
+                # harvested them, but every one WAS answered — the
+                # lifecycle stream records that, so the abort blackbox
+                # below still accounts for every id.  (Rejected queued
+                # requests already got their "dropped" from the
+                # engine's drain.)
+                if was_resident:
+                    self._lifecycle.emit("dropped", req.request_id,
+                                         reason="rejected_draining",
+                                         where="drain_abort")
+                self._lifecycle.emit("responded", req.request_id,
+                                     status="rejected_draining")
+        if aborted() and self._lifecycle is not None and self.blackbox_path:
+            # The hard-abort drain is a forensic moment by definition:
+            # what was in flight when the operator said "stop now".
+            self._lifecycle.dump(self.blackbox_path, reason="drain_abort")
         if aborted():
             print(f"serve: drain aborted by a second signal with "
                   f"{unfinished} resident(s) unfinished; exiting "

@@ -32,11 +32,13 @@ from .registry import (
     MetricsRegistry,
     ScalarWriterSink,
 )
+from .lifecycle import LifecycleTracer
 from .spans import NULL_SPAN, SpanTracer, trace_span
 
 __all__ = [
     "METRICS_SCHEMA", "NULL_SPAN", "STEP_PHASES",
-    "JsonlSink", "MetricsRegistry", "ScalarWriterSink", "SpanTracer",
+    "JsonlSink", "LifecycleTracer", "MetricsRegistry",
+    "ScalarWriterSink", "SpanTracer",
     "StepPhases", "Telemetry",
     "caption_step_flops", "mfu_fields", "peak_tflops", "trace_span",
 ]

@@ -138,6 +138,25 @@ class SpanTracer:
             ev["args"] = args
         self._append(ev)
 
+    def async_event(self, phase: str, name: str, aid, **args) -> None:
+        """Async-track event (Chrome phases ``b``/``n``/``e``): events
+        sharing ``id`` render as ONE track spanning threads — how the
+        request-lifecycle tracer draws a request's journey across the
+        router and replica span rows (telemetry/lifecycle.py).  Chrome
+        pairs ``b``/``e`` by name+cat+id, so callers keep those stable
+        per track and put the varying detail in ``args``."""
+        if phase not in ("b", "n", "e"):
+            raise ValueError(f"async phase must be 'b', 'n' or 'e', "
+                             f"got {phase!r}")
+        now = time.perf_counter()
+        ev = {"name": name, "ph": phase, "cat": "request",
+              "id": str(aid),
+              "ts": (now - self._t_epoch) * 1e6,
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
     def _record(self, name: str, t0: float, t1: float,
                 args: Optional[Dict[str, Any]]) -> None:
         ev = {"name": name, "ph": "X", "cat": "host",

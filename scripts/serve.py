@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 
 import numpy as np
@@ -88,6 +89,22 @@ def build_demo_backend(opt):
         return [t[ix] for t in table]
 
     return model, params, vocab, list(DEMO_FEAT_SHAPES), feats_for
+
+
+def write_exit_snapshot(opt, registry) -> None:
+    """The train.py exit discipline for the serving CLIs: an atomic
+    telemetry.json snapshot on every drain/exit, so serving chaos
+    drills leave the same machine-auditable artifact a training run
+    does.  ``--serve_telemetry_file`` wins; checkpoint mode defaults to
+    ``<checkpoint_path>/telemetry.json``; demo mode defaults to off."""
+    snap_path = opt.serve_telemetry_file
+    if not snap_path and not opt.serve_demo:
+        snap_path = os.path.join(os.path.abspath(opt.checkpoint_path),
+                                 "telemetry.json")
+    if snap_path:
+        os.makedirs(os.path.dirname(os.path.abspath(snap_path)),
+                    exist_ok=True)
+        registry.write_snapshot(snap_path)
 
 
 def build_checkpoint_backend(opt, ds):
@@ -160,6 +177,18 @@ def main(argv=None) -> int:
 
         tracer = SpanTracer(opt.trace_dir)
 
+    # Request-lifecycle tracing + flight recorder (OBSERVABILITY.md
+    # "Request lifecycle & flight recorder"): per-request causal events
+    # into a bounded ring, mirrored into the Chrome trace when
+    # --trace_dir is set; blackbox.json lands on exit 124, on a
+    # hard-abort drain, and on the {"op": "dump"} wire op.
+    lifecycle = None
+    if opt.serve_lifecycle:
+        from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+        lifecycle = LifecycleTracer(opt.serve_lifecycle_events,
+                                    tracer=tracer, registry=registry)
+
     engine = ServingEngine(
         model, {"params": params}, feat_shapes,
         max_len=opt.max_length, beam_size=opt.beam_size,
@@ -175,7 +204,7 @@ def main(argv=None) -> int:
         step_budget_ms=opt.serve_step_budget_ms,
         result_cache=(ResultCache(opt.serve_cache)
                       if opt.serve_cache else None),
-        registry=registry, tracer=tracer)
+        registry=registry, tracer=tracer, lifecycle=lifecycle)
     engine.warm()
     log.info("engine warm: buckets=%s beam=%d chunk=%d queue_limit=%d "
              "deadline_ms=%s recover=%d cache=%d",
@@ -184,7 +213,16 @@ def main(argv=None) -> int:
              int(opt.serve_recover), int(opt.serve_cache))
 
     server = CaptionServer(engine, vocab, feats_for, handler=handler,
-                           registry=registry)
+                           registry=registry, lifecycle=lifecycle,
+                           blackbox_path=(opt.serve_blackbox or None))
+    if lifecycle is not None:
+        # The blackbox's state providers: health (server view, so
+        # draining shows), registry counters, ProgramCache state.
+        lifecycle.attach(
+            health=server.health_payload,
+            counters=lambda: registry.snapshot().get("counters"),
+            program_cache=lambda: {"builds": engine.program_cache.builds,
+                                   "entries": len(engine.program_cache)})
 
     # The serving health plane's liveness file: heartbeat.json once per
     # second (watchdog atomic-write discipline) carrying the SAME health
@@ -218,6 +256,18 @@ def main(argv=None) -> int:
 
             print(f"serve: UNRECOVERABLE: {e}; exiting {EXIT_WEDGE} "
                   f"({describe(EXIT_WEDGE)})", file=sys.stderr)
+            if lifecycle is not None and opt.serve_blackbox:
+                # The crash blackbox: what was in flight when the
+                # self-healing ladder exhausted — written BEFORE the
+                # exit so the evidence outlives the process.
+                try:
+                    lifecycle.dump(opt.serve_blackbox,
+                                   reason="unrecoverable")
+                    print(f"serve: blackbox written to "
+                          f"{opt.serve_blackbox}", file=sys.stderr)
+                except OSError as werr:
+                    print(f"serve: blackbox write failed: {werr}",
+                          file=sys.stderr)
             rc = EXIT_WEDGE
     finally:
         if watchdog is not None:
@@ -233,6 +283,7 @@ def main(argv=None) -> int:
                               {"stats": stats,
                                "health": engine.health(),
                                "telemetry": registry.snapshot()}, indent=2)
+        write_exit_snapshot(opt, registry)
         if tracer is not None:
             tracer.close()
         if ds is not None:

@@ -62,7 +62,8 @@ def main(argv=None) -> int:
 
     import jax
 
-    from serve import build_checkpoint_backend, build_demo_backend  # noqa: E402
+    from serve import (build_checkpoint_backend,  # noqa: E402
+                       build_demo_backend, write_exit_snapshot)
     from cst_captioning_tpu.resilience.faults import FaultPlan
     from cst_captioning_tpu.resilience.preemption import PreemptionHandler
     from cst_captioning_tpu.serving.buckets import ProgramCache, parse_buckets
@@ -110,6 +111,17 @@ def main(argv=None) -> int:
     result_cache = (ResultCache(opt.serve_cache)
                     if opt.serve_cache else None)
 
+    # Fleet-wide request-lifecycle tracing + flight recorder: ONE base
+    # tracer — the router owns intake events, each replica's engine gets
+    # a labeled view, and the blackbox carries the per-replica health
+    # breakdown (OBSERVABILITY.md "Request lifecycle & flight recorder").
+    lifecycle = None
+    if opt.serve_lifecycle:
+        from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+        lifecycle = LifecycleTracer(opt.serve_lifecycle_events,
+                                    tracer=tracer, registry=registry)
+
     def engine_factory(replica: int) -> ServingEngine:
         return ServingEngine(
             model, {"params": params}, feat_shapes,
@@ -127,14 +139,16 @@ def main(argv=None) -> int:
             step_budget_ms=opt.serve_step_budget_ms,
             result_cache=result_cache,
             program_cache=programs,
-            registry=registry, tracer=tracer)
+            registry=registry, tracer=tracer,
+            lifecycle=(lifecycle.for_replica(replica)
+                       if lifecycle is not None else None))
 
     local = jax.local_devices()
     devices = local if len(local) > 1 else None
     router = FleetRouter(engine_factory, opt.serve_replicas,
                          devices=devices,
                          restart_limit=opt.serve_restart_limit,
-                         registry=registry)
+                         registry=registry, lifecycle=lifecycle)
     router.warm()
     log.info("fleet warm: %d replica(s) over %d device(s), buckets=%s "
              "beam=%d chunk=%d compiles=%d", opt.serve_replicas,
@@ -143,7 +157,18 @@ def main(argv=None) -> int:
 
     server = CaptionServer(router, vocab, feats_for, handler=handler,
                            registry=registry,
-                           health_source=router.health)
+                           health_source=router.health,
+                           lifecycle=lifecycle,
+                           blackbox_path=(opt.serve_blackbox or None))
+    if lifecycle is not None:
+        # Blackbox state providers: the server health view (per-replica
+        # detail via the router's health source, draining folded in),
+        # registry counters, the shared ProgramCache.
+        lifecycle.attach(
+            health=server.health_payload,
+            counters=lambda: registry.snapshot().get("counters"),
+            program_cache=lambda: {"builds": programs.builds,
+                                   "entries": len(programs)})
 
     watchdog = None
     if opt.serve_heartbeat_file or opt.wedge_timeout > 0:
@@ -172,6 +197,17 @@ def main(argv=None) -> int:
 
             print(f"serve_fleet: UNRECOVERABLE: {e}; exiting {EXIT_WEDGE} "
                   f"({describe(EXIT_WEDGE)})", file=sys.stderr)
+            if lifecycle is not None and opt.serve_blackbox:
+                # The crash blackbox (exit 124): what was in flight
+                # when the last replica died — written BEFORE the exit.
+                try:
+                    lifecycle.dump(opt.serve_blackbox,
+                                   reason="fleet_unrecoverable")
+                    print(f"serve_fleet: blackbox written to "
+                          f"{opt.serve_blackbox}", file=sys.stderr)
+                except OSError as werr:
+                    print(f"serve_fleet: blackbox write failed: {werr}",
+                          file=sys.stderr)
             rc = EXIT_WEDGE
     finally:
         if watchdog is not None:
@@ -187,6 +223,7 @@ def main(argv=None) -> int:
                               {"stats": stats,
                                "health": router.health(),
                                "telemetry": registry.snapshot()}, indent=2)
+        write_exit_snapshot(opt, registry)
         if tracer is not None:
             tracer.close()
         if ds is not None:

@@ -141,6 +141,43 @@ def main(argv=None) -> int:
                  f"status {pr.get('status')}, "
                  f"{fmt(pr.get('restarts'))} restart(s) / "
                  f"{fmt(pr.get('kills'))} kill(s)"))
+    attribution = rec.get("attribution") or {}
+    lifecycle = rec.get("lifecycle") or {}
+    if attribution:
+        comps = attribution.get("components") or {}
+        for name in ("queue_wait", "admit", "decode", "recovery",
+                     "requeue"):
+            c = comps.get(name) or {}
+            rows.append(
+                (f"attr {name} p50 / p99",
+                 f"{fmt(c.get('p50_ms'), ' ms')} / "
+                 f"{fmt(c.get('p99_ms'), ' ms')}"))
+        rows.append(
+            ("attr reconcile",
+             f"ok={attribution.get('reconcile_ok')} over "
+             f"{fmt(attribution.get('reconciled'))} request(s), max "
+             f"residual {fmt(attribution.get('max_residual_ms'), ' ms')} "
+             f"(tol {fmt(attribution.get('tolerance_ms'), ' ms')})"))
+        for rep_ix, comp in (attribution.get("per_replica") or {}).items():
+            dec = comp.get("decode") or {}
+            qw = comp.get("queue_wait") or {}
+            rq = comp.get("requeue") or {}
+            rows.append(
+                (f"  replica {rep_ix} attr",
+                 f"queue {fmt(qw.get('p50_ms'), ' ms')} / decode "
+                 f"{fmt(dec.get('p50_ms'), ' ms')} / requeue "
+                 f"{fmt(rq.get('p50_ms'), ' ms')} (p50)"))
+    if lifecycle.get("enabled"):
+        rows.append(
+            ("lifecycle accounting",
+             f"terminal_ok={lifecycle.get('terminal_ok')} — "
+             f"{fmt(lifecycle.get('submitted'))} submitted, "
+             f"{fmt(lifecycle.get('unterminated'))} unterminated, "
+             f"{fmt(lifecycle.get('multi_terminal'))} multi-terminal "
+             f"({fmt(lifecycle.get('events'))} events, "
+             f"{fmt(lifecycle.get('retained'))} retained)"))
+        if lifecycle.get("blackbox"):
+            rows.append(("blackbox", str(lifecycle["blackbox"])))
     rows += [
         ("recompiles after warmup", fmt(rec.get("recompiles_after_warmup"))),
         ("expired / deadline-shed", f"{fmt(rec.get('expired'))} / "
@@ -193,6 +230,19 @@ def main(argv=None) -> int:
     if stream.get("enabled") and stream.get("prefix_ok") is False:
         print("  !! streamed chunks are not prefix-consistent with the "
               "final captions (SERVING.md 'Streaming & result cache')",
+              file=sys.stderr)
+        rc = 1
+    if lifecycle.get("enabled") and lifecycle.get("terminal_ok") is False:
+        print("  !! lifecycle accounting broken: some request id never "
+              "reached exactly one terminal event — the flight "
+              "recorder's stream is lying or a request was silently "
+              "lost (OBSERVABILITY.md 'Request lifecycle')",
+              file=sys.stderr)
+        rc = 1
+    if attribution and attribution.get("reconcile_ok") is False:
+        print("  !! latency attribution does not reconcile: component "
+              "sums diverge from measured request latency beyond "
+              "tolerance (OBSERVABILITY.md 'Request lifecycle')",
               file=sys.stderr)
         rc = 1
     return rc

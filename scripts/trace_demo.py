@@ -82,9 +82,11 @@ def main() -> int:
     print("\n=== per-phase trace summary ===")
     import trace_report
 
-    events, files = trace_report.load_events(trace_dir)
-    rows, wall_ms = trace_report.summarize(events)
-    trace_report.print_table(rows, wall_ms, len(files))
+    spans, instants, asyncs, files = trace_report.load_events(trace_dir)
+    wall_ms = trace_report.traced_wall_ms(spans, instants, asyncs)
+    rows, _ = trace_report.summarize(spans, wall_ms)
+    trace_report.print_table(rows, f"trace summary: {len(files)} file(s), "
+                                   f"traced wall {wall_ms:.1f} ms")
 
     print(f"\ntrace files:   {trace_dir}/trace_*.json "
           "(load in https://ui.perfetto.dev)")

@@ -1,12 +1,19 @@
 #!/usr/bin/env python
-"""Summarize a ``--trace_dir`` of Chrome-trace JSON into a per-phase table.
+"""Summarize a ``--trace_dir`` of Chrome-trace JSON into terminal tables.
 
-The trainer's span tracer (cst_captioning_tpu/telemetry/spans.py) writes
-``trace_*.json`` files; this reads every one in the directory, aggregates
-the complete ("ph": "X") events by span name, and prints where the host
-wall-time went — count, total, mean, p50/p95/max, and share of the traced
-wall span.  The same files load graphically in Perfetto
-(https://ui.perfetto.dev) or chrome://tracing; this is the terminal view.
+The span tracer (cst_captioning_tpu/telemetry/spans.py) writes
+``trace_*.json`` files; this reads every one in the directory and prints
+where the host wall-time went:
+
+- complete ("ph": "X") duration spans, aggregated by name — count,
+  total, mean, p50/p95/max, share of the traced wall span;
+- instant ("ph": "i") marker events — count per name (fault firings,
+  one-shot markers);
+- async-track events ("ph": "b"/"n"/"e", the request-lifecycle tracer's
+  Perfetto mirror) — per-track durations matched b->e on (pid, cat, id,
+  name), aggregated by name, plus the per-event step counts.  This is
+  the terminal view of a request's journey; the same files load
+  graphically in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
 
 Usage:
   python scripts/trace_report.py --trace_dir /tmp/run/trace [--json out.json]
@@ -29,8 +36,9 @@ from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
 
 
 def load_events(trace_dir: str):
-    """Every complete span event from every trace_*.json part file."""
-    events = []
+    """Every span/instant/async event from every trace_*.json part file
+    -> (complete_spans, instants, async_events, files)."""
+    spans, instants, asyncs = [], [], []
     files = sorted(glob.glob(os.path.join(trace_dir, "*.json")))
     for path in files:
         try:
@@ -41,9 +49,14 @@ def load_events(trace_dir: str):
                   file=sys.stderr)
             continue
         for ev in doc.get("traceEvents", doc if isinstance(doc, list) else []):
-            if ev.get("ph") == "X" and "dur" in ev:
-                events.append(ev)
-    return events, files
+            ph = ev.get("ph")
+            if ph == "X" and "dur" in ev:
+                spans.append(ev)
+            elif ph == "i":
+                instants.append(ev)
+            elif ph in ("b", "n", "e"):
+                asyncs.append(ev)
+    return spans, instants, asyncs, files
 
 
 def percentile(sorted_vals, q: float) -> float:
@@ -53,16 +66,8 @@ def percentile(sorted_vals, q: float) -> float:
     return sorted_vals[ix]
 
 
-def summarize(events):
-    """-> (rows sorted by total desc, wall_ms).  Durations in ms."""
-    by_name = {}
-    t_lo, t_hi = None, None
-    for ev in events:
-        by_name.setdefault(ev["name"], []).append(ev["dur"] / 1e3)
-        ts, end = ev["ts"], ev["ts"] + ev["dur"]
-        t_lo = ts if t_lo is None else min(t_lo, ts)
-        t_hi = end if t_hi is None else max(t_hi, end)
-    wall_ms = 0.0 if t_lo is None else (t_hi - t_lo) / 1e3
+def _dur_rows(by_name, wall_ms: float):
+    """name -> [durations ms] into the shared span-table row shape."""
     rows = []
     for name, durs in by_name.items():
         durs.sort()
@@ -79,23 +84,95 @@ def summarize(events):
                            else 0.0,
         })
     rows.sort(key=lambda r: -r["total_ms"])
-    return rows, wall_ms
+    return rows
 
 
-def print_table(rows, wall_ms: float, nfiles: int) -> None:
+def traced_wall_ms(*event_lists) -> float:
+    """Wall span (ms) over EVERY timestamped event — duration spans,
+    instants, and async steps together, so the pct_of_wall columns of
+    both tables share one honest denominator."""
+    t_lo, t_hi = None, None
+    for events in event_lists:
+        for ev in events:
+            ts = ev.get("ts")
+            if ts is None:
+                continue
+            end = ts + ev.get("dur", 0.0)
+            t_lo = ts if t_lo is None else min(t_lo, ts)
+            t_hi = end if t_hi is None else max(t_hi, end)
+    return 0.0 if t_lo is None else (t_hi - t_lo) / 1e3
+
+
+def summarize(events, wall_ms=None):
+    """-> (rows sorted by total desc, wall_ms).  Durations in ms."""
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev["dur"] / 1e3)
+    if wall_ms is None:
+        wall_ms = traced_wall_ms(events)
+    return _dur_rows(by_name, wall_ms), wall_ms
+
+
+def summarize_instants(instants):
+    """Instant markers -> [{"name", "count"}] sorted by count desc."""
+    counts = {}
+    for ev in instants:
+        counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    return [{"name": n, "count": c}
+            for n, c in sorted(counts.items(), key=lambda kv: -kv[1])]
+
+
+def summarize_async(asyncs, wall_ms: float):
+    """Async-track events -> (track_rows, step_counts, open_tracks).
+
+    Tracks are matched ``b`` -> ``e`` on (pid, cat, id, name) — the
+    Chrome pairing rule — and their durations aggregate by name in the
+    same row shape as the span table.  ``n`` step events count per name
+    (the lifecycle event mix).  Tracks begun but never ended (requests
+    in flight when the trace closed) are reported, not dropped.
+    """
+    open_at = {}
+    by_name = {}
+    steps = {}
+    unmatched_end = 0
+    for ev in sorted(asyncs, key=lambda e: e.get("ts", 0.0)):
+        key = (ev.get("pid"), ev.get("cat"), ev.get("id"), ev["name"])
+        ph = ev["ph"]
+        if ph == "b":
+            open_at[key] = ev["ts"]
+        elif ph == "e":
+            t0 = open_at.pop(key, None)
+            if t0 is None:
+                unmatched_end += 1
+                continue
+            by_name.setdefault(ev["name"], []).append(
+                (ev["ts"] - t0) / 1e3)
+        else:  # "n": an instant step on the track
+            steps[ev["name"]] = steps.get(ev["name"], 0) + 1
+    rows = _dur_rows(by_name, wall_ms)
+    step_rows = [{"name": n, "count": c}
+                 for n, c in sorted(steps.items(), key=lambda kv: -kv[1])]
+    return rows, step_rows, {"open_tracks": len(open_at),
+                             "unmatched_end": unmatched_end}
+
+
+def print_table(rows, title: str) -> None:
     cols = ("span", "count", "total_ms", "mean_ms", "p50_ms", "p95_ms",
             "max_ms", "pct_of_wall")
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows)) if rows
               else len(c) for c in cols}
-    print(f"trace summary: {nfiles} file(s), traced wall {wall_ms:.1f} ms")
+    print(title)
     print("  ".join(c.ljust(widths[c]) for c in cols))
     print("  ".join("-" * widths[c] for c in cols))
     for r in rows:
         print("  ".join(str(r[c]).ljust(widths[c]) for c in cols))
-    if rows:
-        print("\nnote: nested spans overlap (e.g. host-path `score` runs "
-              "inside `compute`), so pct_of_wall columns need not sum "
-              "to 100.")
+
+
+def print_counts(rows, title: str) -> None:
+    width = max(len(r["name"]) for r in rows)
+    print(title)
+    for r in rows:
+        print(f"  {r['name']:<{width}}  {r['count']}")
 
 
 def main() -> int:
@@ -106,17 +183,42 @@ def main() -> int:
                     help="also write the summary rows as JSON here")
     args = ap.parse_args()
 
-    events, files = load_events(args.trace_dir)
+    spans, instants, asyncs, files = load_events(args.trace_dir)
     if not files:
         print(f"trace_report: no trace files under {args.trace_dir}",
               file=sys.stderr)
         return 1
-    rows, wall_ms = summarize(events)
-    print_table(rows, wall_ms, len(files))
+    wall_ms = traced_wall_ms(spans, instants, asyncs)
+    rows, _ = summarize(spans, wall_ms)
+    print_table(rows, f"trace summary: {len(files)} file(s), traced wall "
+                      f"{wall_ms:.1f} ms")
+    if rows:
+        print("\nnote: nested spans overlap (e.g. host-path `score` runs "
+              "inside `compute`), so pct_of_wall columns need not sum "
+              "to 100.")
+    async_rows, step_rows, async_meta = summarize_async(asyncs, wall_ms)
+    if async_rows or step_rows:
+        print()
+        print_table(async_rows,
+                    "async tracks (request lifecycle; b->e durations)")
+        if async_meta["open_tracks"]:
+            print(f"  ({async_meta['open_tracks']} track(s) still open — "
+                  "in flight when the trace closed)")
+        if step_rows:
+            print()
+            print_counts(step_rows, "lifecycle steps (async 'n' events)")
+    if instants:
+        print()
+        print_counts(summarize_instants(instants),
+                     "instant markers ('i' events)")
     if args.json:
         atomic_json_write(args.json,
                           {"wall_ms": wall_ms, "files": files,
-                           "spans": rows}, indent=2)
+                           "spans": rows,
+                           "instants": summarize_instants(instants),
+                           "async_tracks": async_rows,
+                           "async_steps": step_rows,
+                           "async_meta": async_meta}, indent=2)
         print(f"\nwrote {args.json}")
     return 0
 

@@ -1,0 +1,517 @@
+"""Request-lifecycle tracing + flight recorder (ISSUE 14).
+
+Fast slice (tier-1, lock-sanitizer armed like the serving slices):
+- :func:`attribute_request` units — components partition the total for
+  plain decode, admit carve-out, retry->recovery, kill->requeue;
+- :class:`LifecycleTracer` units — bounded ring + truncated-chain
+  accounting, unknown-kind rejection, id-reuse chain splitting,
+  multi-terminal detection, the replica view's intake suppression,
+  blackbox providers (including a dying one) and the dump counter;
+- the Chrome-trace async mirror (``SpanTracer.async_event`` phases) and
+  trace_report's async/instant rendering + extended ``--json``;
+- engine integration: a traced run's accounting/attribution reconcile
+  with the engine's own latency bookkeeping; an UNTRACED engine's
+  ``stats()`` keeps its historical shape; shed/drop terminals are
+  accounted;
+- the server wire ops: ``{"op": "stats"}`` (attribution included) and
+  ``{"op": "dump"}`` (blackbox written; ``no_recorder`` when disarmed),
+  plus ``responded`` terminals on the stream;
+- the serving probe's ``lifecycle``/``attribution`` record + blackbox,
+  and serve_report's two new exit-1 gates;
+- doc pins (OBSERVABILITY.md section, SERVING.md wire ops + counters).
+
+The subprocess CLI drill (scripts/serve.py demo with blackbox +
+telemetry snapshot) is marked ``slow``; ``make serve-trace-demo`` is
+its zero-setup twin.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.serving.bench import serving_probe
+from cst_captioning_tpu.serving.engine import ServingEngine
+from cst_captioning_tpu.serving.server import CaptionServer
+from cst_captioning_tpu.telemetry.lifecycle import (
+    COMPONENTS,
+    EVENT_KINDS,
+    LifecycleTracer,
+    attribute_request,
+)
+from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+from cst_captioning_tpu.telemetry.spans import SpanTracer
+
+V, B, T, D, MAX_LEN = 12, 5, 3, 7, 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """Sanitizer-armed (the PR 11 discipline): the new
+    ``telemetry.lifecycle`` lock is re-validated against the declared
+    LOCK_ORDER under every drill in this file."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = CaptionModel(vocab_size=V, embed_size=16, hidden_size=16,
+                         attn_size=16, dropout_rate=0.0)
+    feats_np = np.random.default_rng(0).normal(
+        size=(B, T, D)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), [jnp.asarray(feats_np)],
+                           np.zeros((B, MAX_LEN), np.int32))
+    params = {**variables["params"]}
+    params["logit"] = {**params["logit"]}
+    params["logit"]["bias"] = params["logit"]["bias"].at[0].add(0.4)
+    return model, {"params": params}, feats_np
+
+
+def _ev(ts, kind, **attrs):
+    return {"ts": float(ts), "id": 0, "kind": kind, **attrs}
+
+
+def _total(comp):
+    return sum(comp[c] for c in COMPONENTS)
+
+
+# -- attribution units -----------------------------------------------------
+
+
+def test_attribute_plain_decode_partitions_total():
+    comp = attribute_request([
+        _ev(0, "received"), _ev(0, "queued"),
+        _ev(5, "admitted", admit_ms=1000.0),
+        _ev(7, "decode_chunk"), _ev(9, "decode_chunk"),
+        _ev(9, "completed", latency_ms=9000.0),
+    ])
+    assert comp["total"] == pytest.approx(9.0)
+    assert _total(comp) == pytest.approx(comp["total"])
+    assert comp["queue_wait"] == pytest.approx(4.0)   # 5s wait - 1s admit
+    assert comp["admit"] == pytest.approx(1.0)
+    assert comp["decode"] == pytest.approx(4.0)
+    assert comp["recovery"] == 0.0 and comp["requeue"] == 0.0
+
+
+def test_attribute_kill_requeue_window():
+    comp = attribute_request([
+        _ev(0, "received"), _ev(0, "queued"), _ev(1, "admitted"),
+        _ev(2, "decode_chunk"), _ev(3, "killed"), _ev(4, "requeued"),
+        _ev(4, "queued"), _ev(6, "admitted"), _ev(7, "decode_chunk"),
+        _ev(8, "completed"),
+    ])
+    # killed(3) -> readmission(6) is the requeue window — the fleet
+    # restart's cost attributed, never hidden in queue_wait.
+    assert comp["requeue"] == pytest.approx(3.0)
+    assert comp["decode"] == pytest.approx(4.0)
+    assert comp["queue_wait"] == pytest.approx(1.0)
+    assert _total(comp) == pytest.approx(comp["total"]) == pytest.approx(8.0)
+
+
+def test_attribute_retry_recovery():
+    comp = attribute_request([
+        _ev(0, "received"), _ev(0, "queued"), _ev(1, "admitted"),
+        _ev(2, "decode_chunk"), _ev(4, "retry"), _ev(6, "decode_chunk"),
+        _ev(6, "completed"),
+    ])
+    # The failed dispatch (2->4) and its re-run (4->6) are both
+    # recovery; only the clean first chunk is decode.
+    assert comp["recovery"] == pytest.approx(4.0)
+    assert comp["decode"] == pytest.approx(1.0)
+    assert _total(comp) == pytest.approx(comp["total"])
+
+
+def test_attribute_incomplete_chains_are_none():
+    assert attribute_request([_ev(1, "queued"), _ev(2, "completed")]) is None
+    assert attribute_request([_ev(0, "received"), _ev(1, "queued")]) is None
+
+
+# -- tracer units ----------------------------------------------------------
+
+
+def test_ring_bounded_and_truncated_chains_excluded():
+    lc = LifecycleTracer(max_events=16, clock=lambda: 0.0)
+    for i in range(20):
+        lc.emit("received", i, ts=float(i))
+        lc.emit("completed", i, ts=float(i), latency_ms=0.0)
+    assert len(lc.events()) == 16
+    assert lc.emitted() == 40
+    acc = lc.accounting()
+    # Chains whose "received" rotated out are truncated, not counted as
+    # broken — a bounded recorder only vouches for the window it kept.
+    assert acc["terminal_ok"]
+    assert acc["submitted"] == 8 and acc["truncated"] == 0
+
+
+def test_emit_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown lifecycle event kind"):
+        LifecycleTracer().emit("warp", 1)
+
+
+def test_id_reuse_splits_chains():
+    lc = LifecycleTracer(clock=lambda: 0.0)
+    for ts in (0.0, 1.0):
+        lc.emit("received", "a", ts=ts)
+        lc.emit("completed", "a", ts=ts + 0.5, latency_ms=500.0)
+    acc = lc.accounting()
+    assert acc["submitted"] == 2 and acc["terminal_ok"]
+    assert lc.attribution_report()["requests"] == 2
+
+
+def test_unterminated_and_multi_terminal_flagged():
+    lc = LifecycleTracer(clock=lambda: 0.0)
+    lc.emit("received", "x")
+    lc.emit("received", "y")
+    lc.emit("completed", "y", latency_ms=0.0)
+    lc.emit("completed", "y", latency_ms=0.0)
+    acc = lc.accounting()
+    assert not acc["terminal_ok"]
+    assert acc["unterminated"] == 1 and acc["multi_terminal"] == 1
+    assert set(acc["bad_ids"]) == {"x", "y"}
+
+
+def test_replica_view_drops_intake_and_labels():
+    lc = LifecycleTracer(clock=lambda: 0.0)
+    view = lc.for_replica(3)
+    view.emit("received", 1)     # router-owned: dropped by the view
+    view.emit("shed", 1)         # ditto
+    view.emit("queued", 1)
+    evs = lc.events()
+    assert [e["kind"] for e in evs] == ["queued"]
+    assert evs[0]["replica"] == 3
+
+
+def test_blackbox_providers_and_dump_counter(tmp_path):
+    registry = MetricsRegistry()
+    lc = LifecycleTracer(registry=registry, clock=lambda: 0.0)
+    lc.emit("received", 1)
+    lc.emit("completed", 1, latency_ms=0.0)
+    lc.attach(good=lambda: {"x": 1}, bad=lambda: 1 / 0)
+    path = tmp_path / "blackbox.json"
+    doc = lc.dump(str(path), reason="drill")
+    on_disk = json.loads(path.read_text())
+    assert on_disk["schema"] == doc["schema"] == 1
+    assert on_disk["reason"] == "drill"
+    assert on_disk["good"] == {"x": 1}
+    # A dying provider is reported, never mutes the rest of the dump.
+    assert "provider_error" in on_disk["bad"]
+    assert on_disk["accounting"]["terminal_ok"]
+    assert registry.counter("lifecycle_dumps") == 1
+    assert registry.counter("lifecycle_events") == 2
+
+
+def test_async_mirror_phases(tmp_path):
+    tracer = SpanTracer(str(tmp_path))
+    lc = LifecycleTracer(tracer=tracer, clock=lambda: 0.0)
+    lc.emit("received", 5)
+    lc.emit("queued", 5)
+    lc.emit("completed", 5, latency_ms=0.0)
+    tracer.close()
+    files = [f for f in os.listdir(tmp_path) if f.startswith("trace_")]
+    doc = json.load(open(tmp_path / files[0]))
+    evs = [e for e in doc["traceEvents"] if e.get("cat") == "request"]
+    phases = {e["ph"]: e for e in evs}
+    # b/e pair on the constant track name (Chrome pairing rule), the
+    # step as an instant named by its kind; all share the request id.
+    assert phases["b"]["name"] == phases["e"]["name"] == "request"
+    assert phases["n"]["name"] == "queued"
+    assert {e["id"] for e in evs} == {"5"}
+    with pytest.raises(ValueError):
+        tracer.async_event("x", "request", 5)
+
+
+# -- engine integration ----------------------------------------------------
+
+
+def test_engine_traced_run_reconciles(setup):
+    model, variables, feats = setup
+    registry = MetricsRegistry()
+    lc = LifecycleTracer(registry=registry)
+    eng = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                        decode_chunk=2, bucket_sizes=(1, 2),
+                        queue_limit=0, registry=registry, lifecycle=lc)
+    for i in range(3):
+        eng.submit(i, [feats[i]])
+    comps = eng.run_until_idle()
+    assert len(comps) == 3
+    acc = lc.accounting()
+    assert acc["terminal_ok"] and acc["submitted"] == 3
+    rep = lc.attribution_report()
+    assert rep["requests"] == 3 and rep["reconcile_ok"]
+    # Components sum to the engine's own measured latency (tolerance is
+    # for float noise only — same clock, same timestamps).
+    assert rep["max_residual_ms"] < 1.0
+    st = eng.stats()
+    assert st["attribution"]["reconcile_ok"]
+    assert registry.counter("lifecycle_events") == lc.emitted()
+
+
+def test_untraced_engine_keeps_historical_stats_shape(setup):
+    model, variables, feats = setup
+    eng = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                        decode_chunk=2, bucket_sizes=(1,), queue_limit=0)
+    eng.submit(0, [feats[0]])
+    eng.run_until_idle()
+    assert "attribution" not in eng.stats()
+
+
+def test_shed_and_drop_terminals_accounted(setup):
+    model, variables, feats = setup
+    lc = LifecycleTracer()
+    eng = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                        decode_chunk=2, bucket_sizes=(1,),
+                        queue_limit=1, lifecycle=lc)
+    assert eng.submit(0, [feats[0]])
+    assert not eng.submit(1, [feats[1]])      # bounded queue: shed
+    eng.run_until_idle()
+    acc = lc.accounting()
+    assert acc["terminal_ok"] and acc["submitted"] == 2
+    kinds = {e["id"]: e["kind"] for e in lc.events()
+             if e["kind"] in ("completed", "shed")}
+    assert kinds == {0: "completed", 1: "shed"}
+
+
+# -- the server wire ops ---------------------------------------------------
+
+
+def _server(setup, lc, out, tmp_path, registry=None):
+    model, variables, feats = setup
+    vocab = Vocab({i: f"w{i}" for i in range(1, V)})
+    engine = ServingEngine(model, variables, [(T, D)], max_len=MAX_LEN,
+                           decode_chunk=2, bucket_sizes=(2,),
+                           queue_limit=0, lifecycle=lc, registry=registry)
+    return CaptionServer(engine, vocab, lambda vid: [feats[int(vid)]],
+                         out=out, lifecycle=lc, registry=registry,
+                         blackbox_path=str(tmp_path / "blackbox.json"))
+
+
+def test_server_stats_and_dump_ops(setup, tmp_path):
+    registry = MetricsRegistry()
+    lc = LifecycleTracer(registry=registry)
+    out = io.StringIO()
+    server = _server(setup, lc, out, tmp_path, registry)
+    rc = server.run_stdin([json.dumps({"id": 1, "video_id": "1"}),
+                           json.dumps({"op": "stats"}),
+                           json.dumps({"op": "dump"})])
+    assert rc == 0
+    replies = [json.loads(l) for l in out.getvalue().splitlines()]
+    stats = next(r for r in replies if r.get("op") == "stats")
+    assert "attribution" in stats and "queue_depth" in stats
+    dump = next(r for r in replies if r.get("op") == "dump")
+    assert dump["path"] == str(tmp_path / "blackbox.json")
+    assert json.loads((tmp_path / "blackbox.json").read_text())["schema"] == 1
+    assert registry.counter("serve_stats_queries") == 1
+    assert registry.counter("serve_dump_queries") == 1
+    # The full story ends in the front end's "responded" marker.
+    chain = [e["kind"] for e in lc.events() if e["id"] == (1, "1")]
+    assert chain[0] == "received" and chain[-1] == "responded"
+    assert "completed" in chain
+    assert lc.accounting()["terminal_ok"]
+
+
+def test_server_dump_without_recorder_errors(setup, tmp_path):
+    out = io.StringIO()
+    server = _server(setup, None, out, tmp_path)
+    rc = server.run_stdin([json.dumps({"op": "dump"})])
+    assert rc == 0
+    reply = json.loads(out.getvalue().splitlines()[0])
+    assert reply["error"] == "no_recorder"
+
+
+# -- probe + serve_report gates --------------------------------------------
+
+
+def test_probe_lifecycle_record_and_blackbox(setup, tmp_path):
+    model, variables, _ = setup
+    bb = tmp_path / "bb.json"
+    rec = serving_probe(model, variables, [(T, D)], num_requests=6,
+                        rate_hz=500.0, max_len=MAX_LEN, decode_chunk=2,
+                        bucket_sizes=(1, 2), seed=3, lifecycle=True,
+                        blackbox_path=str(bb))
+    assert rec["lifecycle"]["enabled"] and rec["lifecycle"]["terminal_ok"]
+    assert rec["lifecycle"]["submitted"] == 6
+    assert rec["attribution"]["reconcile_ok"]
+    comps = rec["attribution"]["components"]
+    assert set(comps) == set(COMPONENTS)
+    assert comps["decode"]["p50_ms"] > 0
+    doc = json.loads(bb.read_text())
+    assert doc["reason"] == "probe_end"
+    assert doc["accounting"]["terminal_ok"]
+    assert doc["program_cache"]["builds"] > 0
+
+
+def test_untraced_probe_record_shape(setup):
+    model, variables, _ = setup
+    rec = serving_probe(model, variables, [(T, D)], num_requests=3,
+                        rate_hz=500.0, max_len=MAX_LEN, decode_chunk=2,
+                        bucket_sizes=(1,), seed=3)
+    assert rec["lifecycle"] == {"enabled": False}
+    assert "attribution" not in rec
+
+
+def _run_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def _base_record(**over):
+    rec = {"metric": "serve_captions_per_sec_per_chip", "value": 10.0,
+           "completed": 4, "num_requests": 4, "shed": 0,
+           "recompiles_after_warmup": 0, "rebuild_recompiles": 0}
+    rec.update(over)
+    return rec
+
+
+def test_serve_report_gates_on_lifecycle_accounting(tmp_path):
+    res = _run_report(_base_record(
+        lifecycle={"enabled": True, "terminal_ok": False,
+                   "submitted": 4, "unterminated": 1,
+                   "multi_terminal": 0}), tmp_path)
+    assert res.returncode == 1
+    assert "lifecycle accounting broken" in res.stderr
+
+
+def test_serve_report_gates_on_attribution_reconcile(tmp_path):
+    res = _run_report(_base_record(
+        lifecycle={"enabled": True, "terminal_ok": True, "submitted": 4},
+        attribution={"reconcile_ok": False, "reconciled": 4,
+                     "max_residual_ms": 999.0, "tolerance_ms": 50.0,
+                     "components": {}}), tmp_path)
+    assert res.returncode == 1
+    assert "attribution does not reconcile" in res.stderr
+
+
+def test_serve_report_renders_attribution_rows(tmp_path):
+    comps = {c: {"p50_ms": 1.0, "p99_ms": 2.0, "sum_ms": 4.0}
+             for c in COMPONENTS}
+    res = _run_report(_base_record(
+        lifecycle={"enabled": True, "terminal_ok": True, "submitted": 4,
+                   "unterminated": 0, "multi_terminal": 0, "events": 30,
+                   "retained": 30, "blackbox": "/tmp/bb.json"},
+        attribution={"reconcile_ok": True, "reconciled": 4,
+                     "max_residual_ms": 0.01, "tolerance_ms": 50.0,
+                     "components": comps,
+                     "per_replica": {"0": comps}}), tmp_path)
+    assert res.returncode == 0
+    assert "attr decode p50 / p99" in res.stdout
+    assert "lifecycle accounting" in res.stdout
+    assert "replica 0 attr" in res.stdout
+
+
+def test_serve_report_old_records_render_unchanged(tmp_path):
+    # A pre-ISSUE-14 record (no lifecycle/attribution keys) must render
+    # exactly as before, exit 0, and show none of the new rows.
+    res = _run_report(_base_record(), tmp_path)
+    assert res.returncode == 0
+    assert "attr " not in res.stdout and "lifecycle" not in res.stdout
+
+
+# -- trace_report: instant/async rendering ---------------------------------
+
+
+def test_trace_report_renders_async_and_instants(tmp_path):
+    trace = {"traceEvents": [
+        {"name": "serve.admit", "ph": "X", "ts": 0.0, "dur": 500.0,
+         "pid": 1, "tid": 1},
+        {"name": "fault", "ph": "i", "ts": 10.0, "pid": 1, "tid": 1},
+        {"name": "request", "ph": "b", "cat": "request", "id": "7",
+         "ts": 100.0, "pid": 1, "tid": 1},
+        {"name": "queued", "ph": "n", "cat": "request", "id": "7",
+         "ts": 150.0, "pid": 1, "tid": 1},
+        {"name": "request", "ph": "e", "cat": "request", "id": "7",
+         "ts": 1100.0, "pid": 1, "tid": 1},
+        {"name": "request", "ph": "b", "cat": "request", "id": "8",
+         "ts": 200.0, "pid": 1, "tid": 1},
+    ]}
+    (tmp_path / "trace_1r0.json").write_text(json.dumps(trace))
+    out_json = tmp_path / "summary.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--trace_dir", str(tmp_path), "--json", str(out_json)],
+        capture_output=True, text=True, cwd=REPO)
+    assert res.returncode == 0
+    assert "async tracks" in res.stdout
+    assert "instant markers" in res.stdout
+    assert "1 track(s) still open" in res.stdout
+    doc = json.loads(out_json.read_text())
+    track = doc["async_tracks"][0]
+    assert track["span"] == "request" and track["count"] == 1
+    assert track["total_ms"] == pytest.approx(1.0)
+    assert doc["async_steps"] == [{"name": "queued", "count": 1}]
+    assert doc["instants"] == [{"name": "fault", "count": 1}]
+    assert doc["async_meta"]["open_tracks"] == 1
+
+
+# -- doc pins --------------------------------------------------------------
+
+
+def test_observability_doc_pins_lifecycle():
+    with open(os.path.join(REPO, "OBSERVABILITY.md")) as f:
+        text = f.read()
+    assert "Request lifecycle & flight recorder" in text
+    for kind in EVENT_KINDS:
+        assert f"`{kind}`" in text, f"OBSERVABILITY.md missing {kind}"
+    for comp in COMPONENTS:
+        assert comp in text, f"OBSERVABILITY.md missing component {comp}"
+
+
+def test_serving_doc_pins_wire_ops_and_counters():
+    with open(os.path.join(REPO, "SERVING.md")) as f:
+        text = f.read()
+    for token in ('{"op": "stats"}', '{"op": "dump"}', "blackbox",
+                  "lifecycle_events", "lifecycle_dumps",
+                  "serve_stats_queries", "serve_dump_queries",
+                  '"schema": 1'):
+        assert token in text, f"SERVING.md missing {token!r}"
+
+
+# -- the CLI drill (slow) --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_demo_blackbox_and_exit_snapshot(tmp_path):
+    """scripts/serve.py demo mode: the {"op": "dump"} wire op writes the
+    blackbox, and exit leaves the telemetry.json snapshot (the train.py
+    artifact discipline on the serving plane)."""
+    bb = tmp_path / "blackbox.json"
+    snap = tmp_path / "telemetry.json"
+    lines = "\n".join([json.dumps({"id": 1, "video_id": "v0"}),
+                       json.dumps({"op": "dump"})]) + "\n"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+         "--serve_demo", "1", "--beam_size", "1",
+         "--serve_blackbox", str(bb),
+         "--serve_telemetry_file", str(snap)],
+        input=lines, capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240)
+    assert res.returncode == 0, res.stderr
+    doc = json.loads(bb.read_text())
+    assert doc["schema"] == 1 and doc["reason"] == "wire_dump"
+    assert doc["health"]["status"] == "ok"
+    assert doc["program_cache"]["builds"] > 0
+    snap_doc = json.loads(snap.read_text())
+    assert snap_doc["schema"] == 2
+    assert snap_doc["counters"]["lifecycle_dumps"] == 1
+    assert snap_doc["counters"]["serve_dump_queries"] == 1

@@ -100,7 +100,7 @@ def setup():
 def build_fleet(setup, replicas=2, *, registry=None, plan=None,
                 result_cache=None, recover=True, retry_limit=2,
                 rebuild_limit=2, restart_limit=3, deadline_ms=0.0,
-                queue_limit=0, clock=None):
+                queue_limit=0, clock=None, lifecycle=None):
     """A fleet over shared ProgramCache (+ optional shared result
     cache); returns (fleet, programs, factory) — the factory doubles as
     the fault-free single-engine reference builder."""
@@ -113,6 +113,8 @@ def build_fleet(setup, replicas=2, *, registry=None, plan=None,
         kw = {}
         if clock is not None:
             kw["clock"] = clock
+        if lifecycle is not None:
+            kw["lifecycle"] = lifecycle.for_replica(k)
         return ServingEngine(
             model, variables, [(T, D)], max_len=MAX_LEN, decode_chunk=2,
             bucket_sizes=(1, 2), queue_limit=queue_limit,
@@ -124,6 +126,8 @@ def build_fleet(setup, replicas=2, *, registry=None, plan=None,
     fleet_kw = {}
     if clock is not None:
         fleet_kw["clock"] = clock
+    if lifecycle is not None:
+        fleet_kw["lifecycle"] = lifecycle
     fleet = FleetRouter(factory, replicas, restart_limit=restart_limit,
                         registry=registry, **fleet_kw)
     return fleet, programs, factory
@@ -738,6 +742,128 @@ def test_resilience_doc_pins_replica_axis():
         text = f.read()
     assert "kind@replica=K" in text
     assert "for_replica" in text
+
+
+# -- request lifecycle across the fleet (ISSUE 14) -------------------------
+
+
+def test_kill_requeue_lifecycle_trace_and_attribution(setup):
+    """The ISSUE-14 satellite drill: a hard replica kill mid-request —
+    the lifecycle stream shows killed -> requeued -> completed in
+    order, the requeue window is attributed (recovery time visible,
+    never hidden), every id reaches exactly one terminal, and captions
+    stay bit-identical to the fault-free single-engine run."""
+    from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+    lc = LifecycleTracer()
+    fleet, programs, factory = build_fleet(setup, 2, lifecycle=lc)
+    fleet.warm()
+    vids = make_videos(6, seed=3)
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done += fleet.step()
+    eng0 = fleet._replicas[0].engine
+    assert eng0.resident_count > 0
+    killed_ids = [req.request_id for req in eng0.resident_requests()]
+    fleet.kill_replica(0)
+    done += fleet.run_until_idle()
+    # Accounting/attribution BEFORE the untraced-irrelevant reference
+    # decode below (the shared factory traces everything it builds).
+    acc = lc.accounting()
+    assert acc["terminal_ok"] and acc["submitted"] == 6
+    rep = lc.attribution_report()
+    assert rep["reconcile_ok"] and rep["requests"] == 6
+    assert rep["components"]["requeue"]["p99_ms"] > 0
+    chains = {}
+    for ev in lc.events():
+        chains.setdefault(ev["id"], []).append(ev["kind"])
+    assert killed_ids
+    for rid in killed_ids:
+        ks = chains[rid]
+        assert ks.index("killed") < ks.index("requeued") \
+            < ks.index("completed")
+    # Per-replica attribution groups by the COMPLETING replica
+    # (JSON-stable string keys).
+    assert set(rep["per_replica"]) <= {"0", "1"}
+    got = {c.request_id: np.asarray(c.tokens) for c in done}
+    ref = reference_tokens(factory, vids)
+    for i in range(6):
+        np.testing.assert_array_equal(got[i], ref[i])
+
+
+def test_replica_wedge_124_lifecycle_shows_retry_kill_requeue(setup):
+    """The @replica=K fault axis consumed as an in-process 124: the
+    wedged replica's residents carry retry -> killed -> requeued ->
+    completed in the stream, with the books still balancing."""
+    from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+    plan = FaultPlan.parse("serve_wedge@replica=0")
+    lc = LifecycleTracer()
+    fleet, programs, factory = build_fleet(
+        setup, 2, plan=plan, recover=True, retry_limit=0,
+        rebuild_limit=0, lifecycle=lc)
+    fleet.warm()
+    vids = make_videos(4, seed=5)
+    done = []
+    for i, f in enumerate(vids):
+        assert fleet.submit(i, f)
+    done += fleet.run_until_idle()
+    assert {c.request_id for c in done} == set(range(4))
+    acc = lc.accounting()
+    assert acc["terminal_ok"] and acc["submitted"] == 4
+    assert lc.attribution_report()["reconcile_ok"]
+    chains = {}
+    for ev in lc.events():
+        chains.setdefault(ev["id"], []).append(ev["kind"])
+    wedged = [rid for rid, ks in chains.items() if "retry" in ks]
+    assert wedged, "the injected wedge never hit a traced resident"
+    for rid in wedged:
+        ks = chains[rid]
+        assert ks.index("retry") < ks.index("killed") \
+            < ks.index("requeued") < ks.index("completed")
+    ref = reference_tokens(factory, vids)
+    got = {c.request_id: np.asarray(c.tokens) for c in done}
+    for i in range(4):
+        np.testing.assert_array_equal(got[i], ref[i])
+
+
+def test_fleet_heartbeat_carries_per_replica(setup, tmp_path):
+    """ISSUE-14 satellite pin: the fleet heartbeat file carries the
+    per_replica health breakdown (via the server's pluggable health
+    source), not just the worst-of-replicas status."""
+    import time as _time
+
+    from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
+
+    registry = MetricsRegistry()
+    fleet, _, _ = build_fleet(setup, 2, registry=registry)
+    fleet.warm()
+    server = CaptionServer(fleet, vocab=None, feats_for=lambda v: None,
+                           registry=registry, health_source=fleet.health)
+    hb = tmp_path / "heartbeat.json"
+    wd = ProgressWatchdog(
+        0, describe=lambda: "fleet heartbeat pin",
+        heartbeat_path=str(hb),
+        payload=lambda: {"serving": server.health_payload(),
+                         **registry.heartbeat_payload()},
+        heartbeat_interval_s=0.05).start()
+    try:
+        deadline = _time.monotonic() + 10.0
+        while not hb.exists() and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+    finally:
+        wd.stop()
+    doc = json.loads(hb.read_text())
+    per = doc["serving"]["per_replica"]
+    assert {p["replica"] for p in per} == {0, 1}
+    for p in per:
+        assert p["status"] == "ok"
+        assert "restarts" in p and "kills" in p and "recovery" in p
+    # The fleet counters ride in the same payload (worst-of status +
+    # detail + registry counters — one machine-auditable file).
+    assert doc["serving"]["status"] == "ok"
+    assert "fleet_routed" in doc["counters"]
 
 
 # -- slow subprocess drill (make serve-fleet-chaos) ------------------------

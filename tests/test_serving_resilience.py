@@ -792,7 +792,7 @@ def test_serve_cli_chaos_drill_bit_identical(tmp_path):
 
 
 @pytest.mark.slow
-def test_serve_cli_double_sigterm_exits_hard():
+def test_serve_cli_double_sigterm_exits_hard(tmp_path):
     """First TERM drains; a second TERM mid-drain aborts it and exits
     143 (sigterm_unwind).  The demo model's EOS is suppressed
     (--serve_demo_eos_bias -8) so every resident decodes the full 60
@@ -800,7 +800,9 @@ def test_serve_cli_double_sigterm_exits_hard():
     TERM is made un-missable by freezing the server (SIGSTOP) as soon as
     the first TERM's PREEMPT ack appears, queuing the TERM, and resuming
     (SIGCONT): the drain-loop's abort check sees it on the very next
-    iteration."""
+    iteration.  The hard abort is also a flight-recorder trigger
+    (ISSUE 14): the blackbox must land, reason ``drain_abort``, with
+    the abandoned residents' terminals recorded."""
     import threading
 
     from cst_captioning_tpu.resilience.exitcodes import (
@@ -808,13 +810,15 @@ def test_serve_cli_double_sigterm_exits_hard():
         classify,
     )
 
+    blackbox = tmp_path / "blackbox.json"
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
          "--serve_demo", "1", "--serve_demo_eos_bias", "-8",
          "--beam_size", "1", "--max_length", "500", "--decode_chunk", "1",
-         "--serve_buckets", "8", "--loglevel", "WARNING"],
+         "--serve_buckets", "8", "--loglevel", "WARNING",
+         "--serve_blackbox", str(blackbox)],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, cwd=REPO, env=env)
     errlines = []
@@ -862,5 +866,14 @@ def test_serve_cli_double_sigterm_exits_hard():
                     if r.get("error") == "rejected_draining"}
         answered = {r["id"] for r in replies if "id" in r}
         assert rejected and answered == set(range(12))
+        # The abort blackbox: dumped DURING the abort, every answered
+        # request terminal in the stream (the drain_abort drops cover
+        # the abandoned residents).
+        doc = json.loads(blackbox.read_text())
+        assert doc["reason"] == "drain_abort"
+        assert doc["accounting"]["terminal_ok"], doc["accounting"]
+        assert any(e["kind"] == "dropped"
+                   and e.get("where") == "drain_abort"
+                   for e in doc["events"])
     finally:
         proc.kill()
