@@ -35,7 +35,7 @@ TRAIN_COMMON = \
         demo trace-demo scale_chain report collect chip_window tune \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
         serve-chaos serve-fleet-bench serve-fleet-chaos serve-trace-demo \
-        bf16-parity clean
+        bf16-parity data-bench clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -160,6 +160,22 @@ tune-fast:
 
 tune-report:
 	$(PY) scripts/tune_report.py
+
+# Data-plane feed probe (ISSUE 15): the loader-only `bench.py --stage
+# data` — batches/s + caps/s out of the real prefetcher at 4 assembler
+# workers, the single-worker twin at the same seed, data_wait share at a
+# simulated consumer running XE at the recorded 30k caps/s rate, and
+# queue occupancy — summarized by scripts/data_report.py, which exits 1
+# unless 4 workers sustain >= 2x the single-worker feed rate.  A fast
+# CPU smoke like `tune-fast`; its API twin rides in tier-1
+# (tests/test_data_plane.py).  Bare `python bench.py --stage data
+# --loader_workers 4` measures the full default shape.
+data-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --stage data --platform cpu --cache 0 \
+	  --batch_size 8 --seq_per_img 4 --seq_len 16 --vocab 500 \
+	  --loader_workers 4 --data_videos 32 --data_batches 24 \
+	  --data_read_ms 6 > /tmp/cst_data_bench.json
+	$(PY) scripts/data_report.py --file /tmp/cst_data_bench.json
 
 # -- caption serving (SERVING.md) -----------------------------------------
 

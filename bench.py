@@ -515,17 +515,63 @@ def bench_serving(args) -> dict:
     return out
 
 
+def bench_data(args) -> dict:
+    """Loader-only feed-rate probe (--stage data): the real prefetcher
+    (data/loader.py prefetch_to_device) over an in-memory synthetic
+    source with a declared simulated read latency — batches/s + caps/s
+    drained flat out, plus data_wait share and queue occupancy at a
+    simulated consumer rate (data/bench.py).  With --loader_workers > 1
+    the single-worker twin runs at the SAME seed in the same bench run
+    (after an unmeasured rehearsal, the serve_cache_compare discipline:
+    first-probe warmup must not land on one twin and fake the gap), so
+    the record carries the multi-worker speedup the data plane claims."""
+    from cst_captioning_tpu.data.bench import feed_probe
+
+    probe_kw = dict(
+        batch_size=args.batch_size, seq_per_img=args.seq_per_img,
+        seq_len=args.seq_len, vocab=args.vocab,
+        num_videos=args.data_videos, workers=args.loader_workers,
+        data_shards=args.data_shards, data_shard_id=args.data_shard_id,
+        read_ms=args.data_read_ms,
+        consumer_ms=args.data_consumer_ms or None,
+        batches=args.data_batches, seed=777,
+        # Deep enough that every worker can hold a ticket plus slack for
+        # emission-order jitter; the occupancy gauge reports the actual.
+        prefetch_size=max(4, args.loader_workers + 2),
+    )
+    out = None
+    if args.data_compare and args.loader_workers > 1:
+        feed_probe(**{**probe_kw, "workers": 1, "batches": 4})  # rehearsal
+        twin = feed_probe(**{**probe_kw, "workers": 1})
+        out = feed_probe(**probe_kw)
+        out["single_worker_captions_per_sec"] = twin["captions_per_sec"]
+        out["single_worker_batches_per_sec"] = twin["batches_per_sec"]
+        out["single_worker_data_wait_share"] = twin["data_wait_share"]
+        if twin["captions_per_sec"] > 0:
+            out["workers_speedup"] = round(
+                out["captions_per_sec"] / twin["captions_per_sec"], 3)
+    else:
+        feed_probe(**{**probe_kw, "batches": 4})  # rehearsal
+        out = feed_probe(**probe_kw)
+    return out
+
+
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--stage", default="both",
-                   choices=("both", "xe", "cst", "serving"),
+                   choices=("both", "xe", "cst", "serving", "data"),
                    help="'both' (default) measures XE and CST and reports "
                         "the MIN as the headline value — the driver artifact "
                         "cannot pass on the easy stage alone.  'serving' "
                         "runs the open-loop Poisson caption-serving probe "
                         "instead (serving/bench.py: p50/p99 request latency "
                         "+ captions/s through the continuous-batching "
-                        "engine, 0 recompiles after warmup asserted)")
+                        "engine, 0 recompiles after warmup asserted).  "
+                        "'data' runs the loader-only feed-rate probe "
+                        "(data/bench.py: batches/s + caps/s out of the "
+                        "real prefetcher, queue occupancy, data_wait "
+                        "share at a simulated consumer rate) — the input-"
+                        "path receipt against the 30k caps/s XE rate")
     p.add_argument("--batch_size", type=int, default=32)
     p.add_argument("--seq_per_img", type=int, default=20)
     p.add_argument("--seq_len", type=int, default=30)
@@ -626,6 +672,44 @@ def parse_args():
                    help="--stage serving: write the flight recorder's "
                         "blackbox.json here at probe end (implies "
                         "--serve_trace 1)")
+    p.add_argument("--loader_workers", type=int, default=1,
+                   help="--stage data: prefetch assembler threads "
+                        "(--loader_workers in the trainer).  > 1 also "
+                        "measures the single-worker twin in the same run "
+                        "(disable with --data_compare 0) and reports "
+                        "workers_speedup — the multi-worker data plane's "
+                        "receipt")
+    p.add_argument("--data_shards", type=int, default=0,
+                   help="--stage data: shard count for the probe's "
+                        "loader (0 = unsharded); the probe then feeds "
+                        "from shard --data_shard_id of the global "
+                        "epoch shuffle")
+    p.add_argument("--data_shard_id", type=int, default=0,
+                   help="--stage data: which shard the probe consumes")
+    p.add_argument("--data_read_ms", type=float, default=10.0,
+                   help="--stage data: simulated per-batch source read "
+                        "latency (h5/NFS-shaped blocking IO; releases "
+                        "the GIL like the real thing).  Default 10ms ~= "
+                        "an ~8MB default-shape batch off a ~0.8GB/s "
+                        "networked store.  Part of the probe's config "
+                        "identity — the feed-rate claim is scoped to it "
+                        "(PARITY.md 'Data-plane feed rate')")
+    p.add_argument("--data_consumer_ms", type=float, default=0.0,
+                   help="--stage data: simulated consumer step time for "
+                        "the data_wait phase; 0 (default) = the per-"
+                        "batch step time of a chip running XE at the "
+                        "recorded 30k caps/s rate")
+    p.add_argument("--data_batches", type=int, default=48,
+                   help="--stage data: measured batches per phase")
+    p.add_argument("--data_videos", type=int, default=64,
+                   help="--stage data: videos in the synthetic source")
+    p.add_argument("--data_compare", type=int, default=1,
+                   help="--stage data: 1 (default) = also measure the "
+                        "single-worker twin at the same seed when "
+                        "--loader_workers > 1, reporting "
+                        "single_worker_captions_per_sec and "
+                        "workers_speedup (scripts/data_report.py gates "
+                        "on >= 2x at 4 workers)")
     p.add_argument("--probe_eos_bias", type=float, default=10.0,
                    help="EOS-logit bias for the rollout step-count probe "
                         "(simulates a converged policy's early "
@@ -729,6 +813,19 @@ def resolved_config(args) -> dict:
         config["serve_trace"] = int(bool(
             getattr(args, "serve_trace", 0)
             or getattr(args, "serve_blackbox", None)))
+    if getattr(args, "stage", None) == "data":
+        # Data-plane feed-probe identity (ISSUE 15): worker count, shard
+        # assignment, simulated source latency, consumer pacing, and the
+        # compare protocol all change what the feed rate means — none may
+        # share a cache entry across values.
+        config["loader_workers"] = args.loader_workers
+        config["data_shards"] = args.data_shards
+        config["data_shard_id"] = args.data_shard_id
+        config["data_read_ms"] = args.data_read_ms
+        config["data_consumer_ms"] = args.data_consumer_ms
+        config["data_batches"] = args.data_batches
+        config["data_videos"] = args.data_videos
+        config["data_compare"] = args.data_compare
     return config
 
 
@@ -825,6 +922,27 @@ def run_measurement(args) -> None:
             common["probe"] = json.loads(probe_json)
         except ValueError:
             pass
+    if args.stage == "data":
+        from cst_captioning_tpu.data.bench import XE_CHIP_CAPS_PER_SEC
+
+        data = bench_data(args)
+        _emit({
+            "metric": HEADLINE_METRIC["data"],
+            "value": data["captions_per_sec"],
+            # The honest ratio for a FEED rate is the demand it must
+            # cover: the recorded peak on-chip XE consumption rate —
+            # >= 1.0 means the input path can keep a chip fed at the
+            # fastest rate the compute path has ever demanded.  Not the
+            # 5000-caps/s training north-star (that measures compute).
+            "vs_baseline": data["vs_xe_rate"],
+            **common,
+            # AFTER **common: a host-side feed rate is captions/s out of
+            # the loader, not captions/s/chip.
+            "unit": "captions/s",
+            **{k: v for k, v in data.items() if k != "captions_per_sec"},
+            "xe_rate_baseline": XE_CHIP_CAPS_PER_SEC,
+        }, args)
+        return
     if args.stage == "serving":
         serve = bench_serving(args)
         _emit({
@@ -1028,6 +1146,7 @@ HEADLINE_METRIC = {
     "cst": "cst_captions_per_sec_per_chip",
     "both": "min_xe_cst_captions_per_sec_per_chip",
     "serving": "serve_captions_per_sec_per_chip",
+    "data": "data_feed_captions_per_sec",
 }
 
 

@@ -32,6 +32,11 @@ HOT_PATHS = (
     "cst_captioning_tpu/serving/fleet.py",
     "cst_captioning_tpu/telemetry/lifecycle.py",
     "cst_captioning_tpu/parallel/",
+    # The sharded multi-worker data plane (ISSUE 15): the prefetch loop
+    # is a per-batch hot path, and its worker threads must obey the
+    # concurrency contracts from day one.
+    "cst_captioning_tpu/data/loader.py",
+    "cst_captioning_tpu/data/sharding.py",
 )
 
 #: Conversions that force a device->host sync when applied to a jax

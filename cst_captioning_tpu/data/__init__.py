@@ -1,18 +1,22 @@
 """Data layer: HDF5 feature/label datasets, batch streaming, prepro, fixtures."""
 
 from .dataset import CaptionDataset, SplitPaths
-from .loader import Batch, CaptionLoader, prefetch_to_device
+from .loader import Batch, BatchPlan, CaptionLoader, prefetch_to_device
+from .sharding import ShardSpec, resolve_shard_spec
 from .vocab import PAD_EOS, Vocab, build_vocab, load_vocab, save_vocab
 
 __all__ = [
     "Batch",
+    "BatchPlan",
     "CaptionDataset",
     "CaptionLoader",
     "PAD_EOS",
+    "ShardSpec",
     "SplitPaths",
     "Vocab",
     "build_vocab",
     "load_vocab",
     "prefetch_to_device",
+    "resolve_shard_spec",
     "save_vocab",
 ]

@@ -1,0 +1,177 @@
+"""Loader-only feed-rate probe — ``bench.py --stage data``.
+
+Measures what the INPUT path can sustain, with the compute path removed:
+batches/s and captions/s out of ``prefetch_to_device`` (the exact
+prefetcher the trainer drives), the prefetch queue's occupancy, and the
+``data_wait_ms`` share a consumer would see at a simulated step rate —
+the receipt that the data plane can keep a chip fed at the recorded
+30k caps/s XE rate (``XE_CHIP_CAPS_PER_SEC``) once the chip window
+reopens.
+
+Honesty (PARITY.md "Data-plane feed rate"): the probe's source is an
+IN-MEMORY synthetic dataset with an explicit simulated per-read latency
+(``read_ms``, modeling h5/NFS-shaped IO, which releases the GIL exactly
+like a real blocking read).  Real h5py sources serialize reads under
+h5py's global lock, so multi-worker gains there come from the packing/
+cast/transfer overlap only — the probe's speedup is scoped to its own
+shapes and source, never claimed for arbitrary stores.
+
+No jax import at module level: the probe is pure host work and must be
+importable before bench.py's backend probe decides where to run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .loader import CaptionLoader, prefetch_to_device
+from .sharding import ShardSpec
+
+#: Peak recorded on-chip XE throughput (captions/s/chip, BENCH_r04 —
+#: PARITY.md).  The probe's ``vs_xe_rate`` and default consumer pacing
+#: derive from it: a feed rate >= 1.0x means the loader can keep one
+#: chip fed at the fastest rate the compute path has ever demanded.
+XE_CHIP_CAPS_PER_SEC = 30447.0
+
+
+class SyntheticFeedDataset:
+    """In-memory CaptionDataset twin for the feed probe: same duck-typed
+    surface the loader consumes (``features``/``captions_for``/
+    ``num_captions``/``video_ids``/``seq_length``/``num_videos``), backed
+    by numpy arrays plus an explicit simulated per-read latency.
+
+    ``read_ms`` sleeps once per ``features()`` call — the blocking-IO
+    shape of an h5/NFS read (sleep releases the GIL, as those reads do),
+    so worker-count scaling measured against it is the IO-overlap story,
+    stated rather than smuggled."""
+
+    def __init__(self, num_videos: int, seq_len: int = 30,
+                 captions_per_video: int = 20, vocab: int = 8000,
+                 feat_shapes: Sequence[Tuple[int, int]] = ((28, 2048),
+                                                          (1, 4096)),
+                 read_ms: float = 0.0, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.video_ids: List[str] = [f"v{i}" for i in range(num_videos)]
+        self._feats = [
+            rng.standard_normal((num_videos, t, d)).astype(np.float32)
+            for t, d in feat_shapes
+        ]
+        self._labels = rng.integers(
+            1, vocab, (num_videos * captions_per_video, seq_len)
+        ).astype(np.int32)
+        self._cpv = captions_per_video
+        self._seq_len = seq_len
+        self._read_ms = float(read_ms)
+
+    @property
+    def num_videos(self) -> int:
+        return len(self.video_ids)
+
+    @property
+    def seq_length(self) -> int:
+        return self._seq_len
+
+    def features(self, video_ix: np.ndarray) -> List[np.ndarray]:
+        if self._read_ms > 0:
+            time.sleep(self._read_ms / 1000.0)
+        ix = np.asarray(video_ix)
+        # .copy() keeps the per-batch allocation+memcpy a real h5 read
+        # pays (a zero-copy fancy-index view would flatter the number).
+        return [f[ix].copy() for f in self._feats]
+
+    def captions_for(self, video_ix: int) -> np.ndarray:
+        s = int(video_ix) * self._cpv
+        return self._labels[s:s + self._cpv]
+
+    def num_captions(self, video_ix: int) -> int:
+        return self._cpv
+
+
+def feed_probe(batch_size: int = 32, seq_per_img: int = 20,
+               seq_len: int = 30, vocab: int = 8000,
+               num_videos: int = 64, workers: int = 1,
+               data_shards: int = 0, data_shard_id: int = 0,
+               read_ms: float = 10.0, consumer_ms: Optional[float] = None,
+               batches: int = 48, prefetch_size: int = 4,
+               warmup: int = 4, seed: int = 0,
+               feat_shapes: Sequence[Tuple[int, int]] = ((28, 2048),
+                                                        (1, 4096)),
+               dataset=None) -> Dict:
+    """One feed-rate measurement at one worker/shard configuration.
+
+    Two phases over the SAME prefetcher configuration:
+
+    1. **Unconstrained drain** — the consumer takes ``batches`` batches
+       as fast as they arrive: the feed rate (batches/s, captions/s).
+    2. **Paced consumer** — the consumer sleeps ``consumer_ms`` per batch
+       (default: the per-batch step time of a chip running XE at
+       ``XE_CHIP_CAPS_PER_SEC``) and measures how long each ``next()``
+       blocked: the ``data_wait_ms`` share at the simulated step rate,
+       plus the mean queue depth seen at each arrival.
+
+    Returns the probe record (one dict, JSON-ready)."""
+    from ..telemetry import Telemetry
+
+    if dataset is None:
+        dataset = SyntheticFeedDataset(
+            num_videos, seq_len=seq_len, captions_per_video=seq_per_img,
+            vocab=vocab, feat_shapes=feat_shapes, read_ms=read_ms,
+            seed=seed)
+    spec = (ShardSpec(int(data_shards), int(data_shard_id))
+            if data_shards else None)
+    loader = CaptionLoader(dataset, batch_size=batch_size,
+                           seq_per_img=seq_per_img, shuffle=True,
+                           seed=seed, shard_spec=spec)
+    caps_per_batch = batch_size * seq_per_img
+    if consumer_ms is None:
+        consumer_ms = caps_per_batch / XE_CHIP_CAPS_PER_SEC * 1000.0
+    telemetry = Telemetry()
+    it = iter(prefetch_to_device(loader, size=prefetch_size,
+                                 workers=workers, telemetry=telemetry))
+    reg = telemetry.registry
+    try:
+        for _ in range(max(int(warmup), 1)):  # warm threads + allocator
+            next(it)
+        t0 = time.perf_counter()
+        for _ in range(int(batches)):
+            next(it)
+        drain_s = time.perf_counter() - t0
+        # Phase 2: paced consumer.
+        waits = []
+        depths = []
+        paced_t0 = time.perf_counter()
+        for _ in range(int(batches)):
+            w0 = time.perf_counter()
+            next(it)
+            waits.append((time.perf_counter() - w0) * 1000.0)
+            depths.append(reg.snapshot()["gauges"].get(
+                "loader_queue_depth", 0))
+            time.sleep(consumer_ms / 1000.0)
+        paced_s = time.perf_counter() - paced_t0
+    finally:
+        it.close()
+        telemetry.close()
+    batches_per_sec = batches / drain_s
+    caps_per_sec = batches_per_sec * caps_per_batch
+    wait_ms_total = float(np.sum(waits))
+    return {
+        "batches_per_sec": round(batches_per_sec, 2),
+        "captions_per_sec": round(caps_per_sec, 1),
+        "vs_xe_rate": round(caps_per_sec / XE_CHIP_CAPS_PER_SEC, 3),
+        "consumer_ms": round(float(consumer_ms), 3),
+        "data_wait_ms_mean": round(float(np.mean(waits)), 3),
+        "data_wait_ms_p99": round(float(np.percentile(waits, 99)), 3),
+        "data_wait_share": round(wait_ms_total / (paced_s * 1000.0), 4),
+        "queue_depth_mean": round(float(np.mean(depths)), 2),
+        "queue_capacity": prefetch_size,
+        "loader_workers": int(workers),
+        "data_shards": int(data_shards),
+        "data_shard_id": int(data_shard_id),
+        "read_ms": float(read_ms),
+        "batches": int(batches),
+        "num_videos": int(num_videos),
+        "retries": reg.counter("loader_retries"),
+    }

@@ -10,9 +10,21 @@ Responsibilities (SURVEY.md §3.5, restated for XLA):
 - raw ground-truth strings carried alongside for the RL reward path;
 - multi-host sharding: each JAX process sees a disjoint stride of the
   video list (``process_index``/``process_count``), the TPU-native
-  replacement for the reference's single-node DataParallel split;
-- ``prefetch_to_device``: a one-deep background thread pipelining h5 reads
-  + ``jax.device_put`` of batch t+1 under the step computation of batch t.
+  replacement for the reference's single-node DataParallel split — or,
+  with an explicit :class:`~.sharding.ShardSpec`, a strided slice of a
+  deterministic GLOBAL epoch shuffle (``data/sharding.py``) whose N
+  shards partition every epoch exactly;
+- ``prefetch_to_device``: background prefetch pipelining h5 reads +
+  ``jax.device_put`` of batch t+1 under the step computation of batch t —
+  one thread by default, or ``workers=N`` assembler threads feeding a
+  bounded ORDERED reassembly queue (batch order bit-identical to the
+  single-thread stream; the multi-worker data plane).
+
+Threading model (enforced by cstlint-threads + the runtime lock
+sanitizer): plan drawing — ALL of the loader's RNG consumption — is
+sequential under ``data.loader.plan``; the reassembly buffer is guarded
+by ``data.loader.queue``; the two are never nested with each other or
+with the telemetry registry (metrics calls happen outside both locks).
 """
 
 from __future__ import annotations
@@ -28,9 +40,19 @@ import numpy as np
 
 from ..resilience.faults import FaultPlan, InjectedFault
 from ..telemetry.spans import NULL_SPAN
+from ..utils.locksan import declare_order, named_lock
 from .dataset import CaptionDataset
+from .sharding import ShardSpec, shard_epoch_order
 
 log = logging.getLogger("cst_captioning_tpu.loader")
+
+#: Declared for the static lock-order rule AND the runtime sanitizer
+#: (analysis/concurrency.py grammar).  The two locks are deliberately
+#: never nested — a worker draws under the plan lock, releases, then
+#: deposits under the queue lock — but declaring the order makes any
+#: future nesting checkable instead of silently deadlock-prone.
+LOCK_ORDER = ("data.loader.plan", "data.loader.queue")
+declare_order(*LOCK_ORDER)
 
 #: Error classes the prefetch worker treats as TRANSIENT (retry with
 #: backoff before poisoning the stream): h5py surfaces flaky NFS/FUSE
@@ -55,6 +77,24 @@ class Batch:
         return len(self.video_ids)
 
 
+@dataclass
+class BatchPlan:
+    """The RNG-determined HALF of a batch: everything ``next_batch``
+    decides (which videos, which caption rows, labels/weights packed)
+    EXCEPT the feature read.  Drawing a plan consumes RNG and must stay
+    sequential; assembling it (``CaptionLoader.assemble``) is pure IO +
+    packing and may run on any worker thread — and may be RETRIED
+    bit-identically, because re-assembling the same plan redraws
+    nothing."""
+
+    seq: int                           # batch ordinal in the stream
+    ix: np.ndarray                     # (B,) dataset indices
+    labels: np.ndarray                 # (B*S, L) int32
+    weights: np.ndarray                # (B*S,) float32
+    video_ids: List[str]
+    gts: Dict[str, List[str]] = field(default_factory=dict)
+
+
 class CaptionLoader:
     """Infinite shuffled batch stream over a CaptionDataset split."""
 
@@ -71,6 +111,7 @@ class CaptionLoader:
         include_gts: bool = False,
         include_feats: bool = True,
         fault_plan: Optional[FaultPlan] = None,
+        shard_spec: Optional[ShardSpec] = None,
     ):
         self.ds = dataset
         # Chaos hook (resilience/faults.py): ``loader_err@batch=N`` raises
@@ -81,7 +122,14 @@ class CaptionLoader:
         self.batch_size = batch_size
         self.seq_per_img = seq_per_img
         self.shuffle = shuffle
-        self._rng = np.random.default_rng(seed + process_index)
+        # Caption-draw RNG stream, per shard: the GLOBAL epoch shuffle
+        # (sharding.py) never draws from it, so its replay discipline
+        # (skip_batches) is shard-count-independent.
+        self._shard = shard_spec
+        self._shard_seed = seed
+        self._epochs_drawn = 0  # epoch ordinal fed to the global shuffle
+        salt = shard_spec.shard_id if shard_spec is not None else process_index
+        self._rng = np.random.default_rng(seed + salt)
         self.consensus_weights = consensus_weights
         self.include_gts = include_gts
         # include_feats=False skips the per-batch h5 feature reads entirely —
@@ -96,9 +144,24 @@ class CaptionLoader:
         # host's shard from (process_index, process_count, num_videos).
         self.process_index = process_index
         self.process_count = process_count
-        self._my_videos = np.arange(dataset.num_videos)[process_index::process_count]
+        if shard_spec is not None and process_count != 1:
+            raise ValueError(
+                "pick ONE sharding scheme: an explicit ShardSpec "
+                "(--data_shards) replaces the per-process strided split, "
+                f"got shard_spec={shard_spec} AND process_count="
+                f"{process_count}")
+        if shard_spec is not None:
+            # Same cardinality as the global-permutation slice (both are
+            # positions shard_id::num_shards), so batches_per_epoch and
+            # iter_eval keep their meaning; the TRAINING order itself
+            # comes from shard_epoch_order at each epoch refill.
+            self._my_videos = np.arange(dataset.num_videos)[
+                shard_spec.shard_id::shard_spec.num_shards]
+        else:
+            self._my_videos = np.arange(dataset.num_videos)[
+                process_index::process_count]
         if len(self._my_videos) == 0:
-            raise ValueError("process shard is empty; dataset smaller than host count")
+            raise ValueError("shard is empty; dataset smaller than shard count")
         self._order = self._my_videos.copy()
         self._pos = len(self._order)  # force shuffle on first batch
         self.epoch = 0
@@ -109,7 +172,17 @@ class CaptionLoader:
         out = []
         while n > 0:
             if self._pos >= len(self._order):
-                if self.shuffle:
+                if self._shard is not None:
+                    # Global-shuffle sharding: this shard's slice of the
+                    # epoch's ONE global permutation — a pure function of
+                    # (seed, epoch), consuming no caption-RNG draws
+                    # (sharding.py; RESILIENCE.md "Sharded resume").
+                    self._order = shard_epoch_order(
+                        self.ds.num_videos, self._shard_seed,
+                        self._epochs_drawn, self._shard,
+                        shuffle=self.shuffle)
+                    self._epochs_drawn += 1
+                elif self.shuffle:
                     self._rng.shuffle(self._order)
                 self._pos = 0
                 self.epoch += 1
@@ -164,37 +237,71 @@ class CaptionLoader:
             return
         log.info("fast-forwarding the batch stream by %d batch(es) "
                  "(deterministic resume alignment)", n)
+        # cstlint: disable=device-scalar-fetch -- host int argument, never a device array
         for _ in range(int(n)):
             for v in self._next_indices(self.batch_size):
+                # cstlint: disable=device-scalar-fetch -- host numpy index rows from _next_indices, never device arrays
                 self._select_caption_rows(int(v), self.ds.num_captions(int(v)))
             self._batches_served += 1
 
-    def next_batch(self) -> Batch:
-        if (self._faults is not None
-                and self._faults.fire("loader_err", self._batches_served)):
-            raise InjectedFault(
-                f"injected transient feature-read error at batch "
-                f"{self._batches_served}")
+    def next_plan(self) -> BatchPlan:
+        """Draw the next batch's PLAN: video indices, caption rows,
+        packed labels/weights — ALL of the stream's RNG consumption, and
+        none of its feature IO.  Sequential by contract: the multi-worker
+        prefetcher serializes calls under ``data.loader.plan`` so the
+        plan sequence is identical to the single-thread stream's."""
         ix = self._next_indices(self.batch_size)
-        feats = self.ds.features(ix) if self.include_feats else []
         labels = np.zeros((self.batch_size * self.seq_per_img, self.ds.seq_length),
                           dtype=np.int32)
         weights = np.ones(self.batch_size * self.seq_per_img, dtype=np.float32)
         vids = []
         for b, v in enumerate(ix):
+            # cstlint: disable=device-scalar-fetch -- host numpy index row from _next_indices, never a device array
             rows, sel = self._pick_captions(int(v))
             labels[b * self.seq_per_img : (b + 1) * self.seq_per_img] = rows
+            # cstlint: disable=device-scalar-fetch -- host numpy index row from _next_indices, never a device array
             vid = self.ds.video_ids[int(v)]
             vids.append(vid)
             if self.consensus_weights is not None and vid in self.consensus_weights:
+                # cstlint: disable=device-scalar-fetch -- consensus weights are a host pickle's numpy arrays, never device
                 w = np.asarray(self.consensus_weights[vid], dtype=np.float32)
                 weights[b * self.seq_per_img : (b + 1) * self.seq_per_img] = w[sel]
         gts = {}
         if self.include_gts and self._refs is not None:
             gts = {vid: self._refs[vid] for vid in vids if vid in self._refs}
+        seq = self._batches_served
         self._batches_served += 1
-        return Batch(feats=feats, labels=labels, weights=weights,
-                     video_ids=vids, gts=gts, video_ix=ix)
+        return BatchPlan(seq=seq, ix=ix, labels=labels, weights=weights,
+                         video_ids=vids, gts=gts)
+
+    def assemble(self, plan: BatchPlan) -> Batch:
+        """Plan -> Batch: the feature read (the expensive, parallel-safe
+        half).  No RNG — a transient failure here is retried by
+        re-assembling the SAME plan, which is bit-identical by
+        construction.  The ``loader_err`` chaos hook fires here (keyed on
+        the plan's batch ordinal) so multi-worker drills inject the fault
+        inside a worker thread, where production failures happen."""
+        if (self._faults is not None
+                and self._faults.fire("loader_err", plan.seq)):
+            raise InjectedFault(
+                f"injected transient feature-read error at batch {plan.seq}")
+        feats = self.ds.features(plan.ix) if self.include_feats else []
+        return Batch(feats=feats, labels=plan.labels, weights=plan.weights,
+                     video_ids=plan.video_ids, gts=plan.gts,
+                     video_ix=plan.ix)
+
+    def next_batch(self) -> Batch:
+        # Fault check BEFORE the plan draw (the historical single-thread
+        # semantics): a retried next_batch() call then draws the same
+        # plan the fault preempted, keeping the stream identical to the
+        # fault-free run.  fire() is single-shot per index, so assemble's
+        # own check cannot double-fire.
+        if (self._faults is not None
+                and self._faults.fire("loader_err", self._batches_served)):
+            raise InjectedFault(
+                f"injected transient feature-read error at batch "
+                f"{self._batches_served}")
+        return self.assemble(self.next_plan())
 
     def __iter__(self) -> Iterator[Batch]:
         while True:
@@ -212,6 +319,7 @@ class CaptionLoader:
                 pad = np.resize(self._my_videos, self.batch_size - len(ix))
                 ix = np.concatenate([ix, pad])
             feats = self.ds.features(ix)
+            # cstlint: disable=device-scalar-fetch -- host numpy index rows (eval iteration), never device arrays
             vids = [self.ds.video_ids[int(v)] for v in ix]
             yield Batch(
                 feats=feats,
@@ -223,11 +331,210 @@ class CaptionLoader:
             )
 
 
+def _cast_feats(b: Batch, feat_dtype) -> Batch:
+    """Host-side feature cast before the wire (``--bf16_feats``): feats
+    only — labels/weights keep their exact dtypes.  Shared by the
+    single-thread and multi-worker prefetch paths so the Batch
+    reconstruction cannot drift between them."""
+    return Batch(feats=[np.asarray(f).astype(feat_dtype) for f in b.feats],
+                 labels=b.labels, weights=b.weights,
+                 video_ids=b.video_ids, gts=b.gts, video_ix=b.video_ix)
+
+
+def _device_put_batch(b: Batch, device_put) -> Batch:
+    """Apply ``device_put`` to every array field (feats/labels/weights);
+    host-only fields ride along untouched."""
+    return Batch(feats=[device_put(f) for f in b.feats],
+                 labels=device_put(b.labels),
+                 weights=device_put(b.weights),
+                 video_ids=b.video_ids, gts=b.gts, video_ix=b.video_ix)
+
+
+class _OrderedPrefetcher:
+    """``workers=N`` assembler threads feeding a bounded ORDERED
+    reassembly queue — the multi-worker data plane behind
+    :func:`prefetch_to_device`.
+
+    Contract: the emitted stream is BIT-IDENTICAL to the single-thread
+    stream, batch for batch (test-pinned).  How: plan drawing — all RNG —
+    stays sequential under ``data.loader.plan`` (workers take turns);
+    assembly (feature IO + packing + optional host cast + device_put)
+    runs in parallel; deposits land in a seq-keyed buffer guarded by
+    ``data.loader.queue`` and the consumer emits strictly in seq order.
+    A transient assembly error is retried by re-assembling the SAME plan
+    (no RNG redraw), so a retry can neither reorder nor alter the stream.
+
+    Backpressure: a counting-semaphore ticket pool bounds in-flight
+    batches (drawn-but-not-consumed) to ``size``, so N workers cannot
+    race ahead of a slow consumer and balloon host/HBM memory.
+
+    Lifecycle: threads are named ``loader-prefetch-<i>`` (trace rows,
+    locksan receipts) and daemonized; abandoning the stream joins ALL of
+    them deadline-bounded — no stray ``loader-prefetch-*`` thread (or
+    prefetched buffer it holds) outlives the consumer (test-pinned,
+    sanitizer-armed).
+    """
+
+    def __init__(self, loader: "CaptionLoader", workers: int, size: int,
+                 device_put, feat_dtype, retries: int,
+                 retry_backoff_s: float, telemetry):
+        self._loader = loader
+        self._workers = int(workers)
+        self._capacity = max(int(size), 1)
+        self._device_put = device_put
+        self._feat_dtype = feat_dtype
+        self._retries = int(retries)
+        self._backoff = float(retry_backoff_s)
+        self._telemetry = telemetry
+        self._tracer = telemetry.tracer if telemetry is not None else None
+        self._plan_lock = named_lock("data.loader.plan")
+        self._qlock = named_lock("data.loader.queue")
+        self._next_draw = 0      # cstlint: guarded_by=self._plan_lock
+        self._poisoned = False   # cstlint: guarded_by=self._plan_lock
+        self._buffer = {}        # cstlint: guarded_by=self._qlock
+        self._next_emit = 0      # cstlint: guarded_by=self._qlock
+        self._avail = threading.Event()   # deposit signal (lock-free wake)
+        self._closed = threading.Event()  # consumer gone: workers drain out
+        self._tickets = threading.Semaphore(self._capacity)
+        self._threads: List[threading.Thread] = []  # cstlint: owned_by=consumer
+        if telemetry is not None:
+            # Declared at 0 (cstlint:declared-counters): a snapshot showing
+            # 0 means the retry path was armed and unused — per worker, so
+            # a drill can assert WHICH worker absorbed the fault.
+            telemetry.declare("loader_retries",
+                              *(f"loader_retries_worker{i}"
+                                for i in range(self._workers)))
+            telemetry.registry.set_gauge("loader_queue_depth", 0)
+            telemetry.registry.set_gauge("loader_queue_capacity",
+                                         self._capacity)
+
+    # -- worker side ---------------------------------------------------------
+
+    def start(self) -> "_OrderedPrefetcher":
+        for i in range(self._workers):
+            t = threading.Thread(target=self._work, args=(i,),
+                                 name=f"loader-prefetch-{i}", daemon=True)
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def _assemble_with_retry(self, plan: BatchPlan, wix: int) -> Batch:
+        delay = self._backoff
+        for attempt in range(self._retries + 1):
+            try:
+                return self._loader.assemble(plan)
+            except TRANSIENT_ERRORS as e:
+                if attempt >= self._retries or self._closed.is_set():
+                    raise
+                if self._telemetry is not None:
+                    self._telemetry.inc("loader_retries")
+                    self._telemetry.inc(f"loader_retries_worker{wix}")
+                log.warning(
+                    "transient batch-read error in loader-prefetch-%d "
+                    "(%s); retry %d/%d of batch %d in %.2fs", wix, e,
+                    attempt + 1, self._retries, plan.seq, delay)
+                time.sleep(delay)
+                delay *= 2
+        raise AssertionError("unreachable")  # loop always returns or raises
+
+    def _finish(self, plan: BatchPlan, wix: int) -> Batch:
+        """Assemble + host cast + device transfer, span-traced on this
+        worker's own trace row (overlap becomes visible in Perfetto)."""
+        if self._tracer is None:
+            b = self._assemble_with_retry(plan, wix)
+        else:
+            with self._tracer.span("prefetch_assemble", batch=plan.seq):
+                b = self._assemble_with_retry(plan, wix)
+        if self._feat_dtype is not None:
+            b = _cast_feats(b, self._feat_dtype)
+        if self._device_put is not None:
+            put_span = (NULL_SPAN if self._tracer is None
+                        else self._tracer.span("prefetch_device_put",
+                                               batch=plan.seq))
+            with put_span:
+                b = _device_put_batch(b, self._device_put)
+        return b
+
+    def _work(self, wix: int) -> None:
+        while not self._closed.is_set():
+            if not self._tickets.acquire(timeout=0.1):
+                continue
+            draw_error = None
+            with self._plan_lock:
+                if self._poisoned or self._closed.is_set():
+                    self._tickets.release()
+                    return
+                seq = self._next_draw
+                self._next_draw += 1
+                try:
+                    plan = self._loader.next_plan()
+                except BaseException as e:
+                    # A failed DRAW may have part-consumed RNG: the
+                    # stream past this point is unknowable.  Poison so no
+                    # worker draws again; the consumer raises at seq.
+                    self._poisoned = True
+                    draw_error = e
+            if draw_error is not None:
+                # Deposited OUTSIDE the plan lock: the module contract is
+                # that the two loader locks (and the registry's) never
+                # nest, on every path including this one.
+                self._deposit(seq, draw_error)
+                return
+            try:
+                item: object = self._finish(plan, wix)
+            except BaseException as e:
+                with self._plan_lock:
+                    self._poisoned = True
+                item = e
+            self._deposit(seq, item)
+
+    def _deposit(self, seq: int, item) -> None:
+        with self._qlock:
+            self._buffer[seq] = item
+            depth = len(self._buffer)
+        self._avail.set()
+        if self._telemetry is not None:  # outside both locks (LOCK_ORDER)
+            self._telemetry.registry.set_gauge("loader_queue_depth", depth)
+
+    # -- consumer side -------------------------------------------------------
+
+    def batches(self) -> Iterator[Batch]:
+        try:
+            while True:
+                self._avail.clear()
+                with self._qlock:
+                    item = self._buffer.pop(self._next_emit, self)
+                    if item is not self:
+                        self._next_emit += 1
+                    depth = len(self._buffer)
+                if item is self:  # next-in-order batch not deposited yet
+                    self._avail.wait(timeout=0.05)
+                    continue
+                if self._telemetry is not None:
+                    self._telemetry.registry.set_gauge(
+                        "loader_queue_depth", depth)
+                if isinstance(item, BaseException):
+                    raise item
+                self._tickets.release()
+                yield item
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Reap every worker: wake them, join deadline-bounded (daemon
+        threads — the deadline abandons the join, never the wake-up)."""
+        self._closed.set()
+        self._avail.set()
+        deadline = time.monotonic() + 5.0
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+
 def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
                        size: int = 2, device_put=None, feat_dtype=None,
                        retries: int = 3,
                        retry_backoff_s: float = 0.05,
-                       telemetry=None) -> Iterator[Batch]:
+                       telemetry=None, workers: int = 1) -> Iterator[Batch]:
     """Run batch assembly (h5 reads, numpy packing) in a background thread,
     optionally applying ``device_put`` (e.g. a sharding-aware jax.device_put)
     to feats/labels/weights before handing the batch to the consumer.
@@ -257,12 +564,39 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
     prefetched HBM buffer it holds — outlives the consumer.
 
     ``telemetry`` (a ``telemetry.Telemetry``, optional): retry attempts
-    count into the ``loader_retries`` counter, and when span tracing is
-    armed the worker records ``prefetch_assemble`` (h5 reads + numpy
+    count into the ``loader_retries`` counter (plus per-worker
+    ``loader_retries_worker<i>`` under ``workers > 1``), the
+    ``loader_queue_depth``/``loader_queue_capacity`` gauges expose the
+    prefetch queue's occupancy between steps (they ride into
+    heartbeat.json via the registry payload), and when span tracing is
+    armed each worker records ``prefetch_assemble`` (h5 reads + numpy
     packing) and ``prefetch_device_put`` spans on its own trace row — the
     overlap of batch t+1's IO under step t's compute becomes visible in
     the Chrome trace.  None = one is-None check per batch.
+
+    ``workers`` (default 1): ``N > 1`` runs N assembler threads through a
+    bounded ORDERED reassembly queue (:class:`_OrderedPrefetcher`) — the
+    emitted stream is bit-identical to the single-thread stream, the
+    contract the multi-worker data plane is pinned to.  Requires a
+    loader-shaped source (``next_plan``/``assemble``); a plain iterator
+    cannot be drawn ahead safely, so it falls back to the single-thread
+    path with a log line.  Parallelism pays when the source reads
+    concurrently (preloaded/in-memory features, thread-safe stores);
+    plain h5py serializes reads under its own global lock, leaving only
+    the packing/cast/transfer work to overlap.
     """
+    if workers > 1:
+        if hasattr(batches, "next_plan"):
+            pf = _OrderedPrefetcher(
+                batches, workers=workers, size=size, device_put=device_put,
+                feat_dtype=feat_dtype, retries=retries,
+                retry_backoff_s=retry_backoff_s, telemetry=telemetry,
+            ).start()
+            yield from pf.batches()
+            return
+        log.warning("prefetch workers=%d needs a loader-shaped source "
+                    "(next_plan/assemble); plain iterator falls back to "
+                    "the single-thread prefetch path", workers)
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = object()
     closed = threading.Event()  # consumer gone: worker must drop its buffers
@@ -271,6 +605,8 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
         # Declared at 0 at prefetch start (cstlint:declared-counters):
         # 0 in the snapshot means the retry path was armed and unused.
         telemetry.declare("loader_retries")
+        telemetry.registry.set_gauge("loader_queue_depth", 0)
+        telemetry.registry.set_gauge("loader_queue_capacity", max(size, 1))
 
     next_batch = getattr(batches, "next_batch", None)
     if next_batch is None:
@@ -323,23 +659,12 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
                 if b is None:  # finite source exhausted
                     break
                 if feat_dtype is not None:
-                    b = Batch(
-                        feats=[np.asarray(f).astype(feat_dtype) for f in b.feats],
-                        labels=b.labels, weights=b.weights,
-                        video_ids=b.video_ids, gts=b.gts, video_ix=b.video_ix,
-                    )
+                    b = _cast_feats(b, feat_dtype)
                 if device_put is not None:
                     put_span = (NULL_SPAN if tracer is None
                                 else tracer.span("prefetch_device_put"))
                     with put_span:
-                        b = Batch(
-                            feats=[device_put(f) for f in b.feats],
-                            labels=device_put(b.labels),
-                            weights=device_put(b.weights),
-                            video_ids=b.video_ids,
-                            gts=b.gts,
-                            video_ix=b.video_ix,
-                        )
+                        b = _device_put_batch(b, device_put)
                 if not _put(b):
                     return
         except Exception as e:  # propagate into the consumer thread
@@ -353,6 +678,8 @@ def prefetch_to_device(batches: Union[CaptionLoader, Iterator[Batch]],
     try:
         while True:
             item = q.get()
+            if telemetry is not None:
+                telemetry.registry.set_gauge("loader_queue_depth", q.qsize())
             if item is stop:
                 return
             if isinstance(item, Exception):

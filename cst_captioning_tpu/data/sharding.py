@@ -1,0 +1,108 @@
+"""Deterministic per-host shard assignment + global epoch shuffle.
+
+The sharded data plane's contract (RESILIENCE.md "Sharded resume"):
+
+- **Partition, exactly.**  Every epoch, the N shards of a dataset are the
+  N strided slices of ONE global permutation — their union is the epoch
+  (no video duplicated, none dropped), pinned by the shard-union test in
+  tests/test_data_plane.py.
+- **Pure-function shuffle.**  The global permutation is a deterministic
+  function of ``(seed, epoch)`` ONLY — it consumes no draws from the
+  loader's caption-selection RNG stream, so the PR 4 RNG-replay
+  discipline (``CaptionLoader.skip_batches`` fast-forwards a resumed run
+  draw-for-draw) holds unchanged under any shard count: a preempted-and-
+  resumed sharded run is bit-identical to its uninterrupted twin.
+- **Shard identity from config, not topology.**  ``--data_shards`` /
+  ``--data_shard_id`` (env fallbacks ``CST_DATA_SHARDS`` /
+  ``CST_DATA_SHARD_ID``) name the shard explicitly, so a run restarted on
+  different hardware keeps its shard — unlike the legacy
+  ``process_index``-strided split, which is implicit in process topology.
+  ``--data_shards 0`` (the default) keeps the legacy behavior.
+
+Every function here is host-side numpy; nothing touches jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+#: Env fallbacks for the CLI flags (resolved as argparse defaults in
+#: opts.py, so a malformed value gets a one-line usage error — the PR 4
+#: env discipline; tests/conftest.py pins both '' for hermeticity).
+ENV_SHARDS = "CST_DATA_SHARDS"
+ENV_SHARD_ID = "CST_DATA_SHARD_ID"
+
+#: Domain-separation salt for the global epoch-shuffle RNG: the shuffle
+#: must never share a stream with any other consumer of ``--seed`` (the
+#: loader's caption draws, model init, rollout keys), or adding a shard
+#: axis would perturb unrelated RNG and break the resume-twin drills.
+_SHUFFLE_SALT = 0x5AD0
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One shard's identity: ``shard_id`` of ``num_shards``."""
+
+    num_shards: int
+    shard_id: int
+
+    def __post_init__(self):
+        if self.num_shards < 1:
+            raise ValueError(
+                f"num_shards must be >= 1, got {self.num_shards}")
+        if not (0 <= self.shard_id < self.num_shards):
+            raise ValueError(
+                f"shard_id must satisfy 0 <= shard_id < num_shards "
+                f"({self.shard_id} vs {self.num_shards})")
+
+    @property
+    def single(self) -> bool:
+        return self.num_shards == 1
+
+
+def resolve_shard_spec(data_shards: int,
+                       data_shard_id: int) -> Optional[ShardSpec]:
+    """CLI flags -> ShardSpec, or None for the legacy per-process split.
+
+    ``--data_shards 0`` (default) means "no explicit sharding": the
+    loader keeps its historical ``process_index``-strided shard.  Any
+    value >= 1 selects the global-shuffle sharded plane.  Range errors
+    were already rejected at argparse time (opts.py); this re-validates
+    for programmatic callers.
+    """
+    if not data_shards:
+        return None
+    return ShardSpec(int(data_shards), int(data_shard_id))
+
+
+def global_epoch_order(num_videos: int, seed: int,
+                       epoch: int) -> np.ndarray:
+    """THE global shuffle: one permutation of the whole epoch, identical
+    on every shard.  A pure function of ``(seed, epoch)`` — a fresh
+    Generator per call, so computing epoch 7's order never depends on
+    having computed epochs 0..6 (resume can jump straight to it)."""
+    rng = np.random.default_rng([_SHUFFLE_SALT, int(seed), int(epoch)])
+    return rng.permutation(int(num_videos))
+
+
+def shard_epoch_order(num_videos: int, seed: int, epoch: int,
+                      spec: ShardSpec, shuffle: bool = True) -> np.ndarray:
+    """This shard's slice of epoch ``epoch``: positions
+    ``shard_id::num_shards`` of the global permutation (or of the
+    identity order when ``shuffle`` is off).  The strided slice is what
+    makes the union property trivial to see: the N slices of one
+    permutation partition it by construction."""
+    if shuffle:
+        order = global_epoch_order(num_videos, seed, epoch)
+    else:
+        order = np.arange(int(num_videos))
+    return order[spec.shard_id::spec.num_shards]
+
+
+def shard_size(num_videos: int, spec: ShardSpec) -> int:
+    """len(shard_epoch_order(...)) without materializing it."""
+    n, k, s = int(num_videos), spec.shard_id, spec.num_shards
+    return (n - k + s - 1) // s

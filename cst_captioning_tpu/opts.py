@@ -72,6 +72,42 @@ def _add_data_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("--preload_feats", type=int, default=0,
                    help="1 = read all feature h5s into host RAM at startup "
                         "(removes per-batch disk IO; needs dataset-sized RAM)")
+    # Sharded multi-worker data plane (data/sharding.py, data/loader.py).
+    # String env defaults + argparse `type` = the PR-4 env discipline: a
+    # malformed CST_LOADER_WORKERS/CST_DATA_SHARDS gets the same one-line
+    # usage error as a malformed flag; tests/conftest.py pins all three
+    # '' for hermeticity, beside CST_TUNED_CONFIGS.
+    g.add_argument("--loader_workers",
+                   type=_positive_int(
+                       "--loader_workers (or CST_LOADER_WORKERS)"),
+                   default=os.environ.get("CST_LOADER_WORKERS") or 1,
+                   help="prefetch assembler threads feeding a bounded "
+                        "ORDERED reassembly queue: batch order stays "
+                        "bit-identical to the single-thread stream while "
+                        "feature reads/packing/transfers overlap.  1 "
+                        "(default) = the historical single prefetch "
+                        "thread.  Env fallback: CST_LOADER_WORKERS")
+    g.add_argument("--data_shards",
+                   type=_nonneg_int("--data_shards (or CST_DATA_SHARDS)",
+                                    "legacy per-process strided split"),
+                   default=os.environ.get("CST_DATA_SHARDS") or 0,
+                   help="explicit dataset shard count: the training "
+                        "stream becomes this shard's strided slice of a "
+                        "deterministic GLOBAL epoch shuffle — N shards "
+                        "partition every epoch exactly (no dup, no "
+                        "drop), and preempt-resume stays bit-identical "
+                        "under any shard count (RESILIENCE.md 'Sharded "
+                        "resume').  0 (default) = the legacy "
+                        "process_index-strided split.  Env fallback: "
+                        "CST_DATA_SHARDS")
+    g.add_argument("--data_shard_id",
+                   type=_nonneg_int(
+                       "--data_shard_id (or CST_DATA_SHARD_ID)",
+                       "the first shard"),
+                   default=os.environ.get("CST_DATA_SHARD_ID") or 0,
+                   help="which shard this run consumes; must satisfy "
+                        "0 <= id < --data_shards.  Env fallback: "
+                        "CST_DATA_SHARD_ID")
 
 
 def _add_model_args(p: argparse.ArgumentParser) -> None:
@@ -824,8 +860,26 @@ def warn_serve_deadline(ns: argparse.Namespace) -> None:
               file=sys.stderr)
 
 
+def _validate_shard_flags(parser: argparse.ArgumentParser,
+                          ns: argparse.Namespace) -> None:
+    """Cross-field shard validation as a one-line usage error (the
+    --fault_plan pattern): per-flag `type` validators can't see each
+    other, so the 0 <= id < shards relation is checked post-parse."""
+    shards = int(getattr(ns, "data_shards", 0) or 0)
+    shard_id = int(getattr(ns, "data_shard_id", 0) or 0)
+    if shards == 0 and shard_id != 0:
+        parser.error(f"--data_shard_id {shard_id} needs --data_shards >= 1 "
+                     "(0 shards = the legacy per-process split, which has "
+                     "no shard ids)")
+    if shards and not (0 <= shard_id < shards):
+        parser.error("--data_shard_id must satisfy 0 <= id < --data_shards, "
+                     f"got id {shard_id} with {shards} shard(s)")
+
+
 def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
-    ns = build_parser().parse_args(argv)
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    _validate_shard_flags(parser, ns)
     apply_tuned_defaults(ns, argv)
     _warn_overlap_under_device_rewards(ns, argv)
     if getattr(ns, "engine", "legacy") == "serving":

@@ -151,6 +151,13 @@ class MetricsRegistry:
                 "last_train": self._last_train,
                 "last_val_step": (self._last_val or {}).get("step"),
                 "counters": dict(self._counters),
+                # Gauges joined the payload for the data plane: the
+                # prefetch queue's depth/occupancy between steps is
+                # exactly the between-heartbeats state a stall
+                # investigation needs (ISSUE 15 satellite) — retries
+                # alone say a fault happened, not whether the queue was
+                # starved or full when it did.
+                "gauges": dict(self._gauges),
             }
 
     def write_snapshot(self, path: str) -> None:

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..data.dataset import CaptionDataset, SplitPaths
 from ..data.loader import CaptionLoader, prefetch_to_device
+from ..data.sharding import resolve_shard_spec
 from ..metrics.ciderd import (
     CiderD,
     build_corpus_df,
@@ -326,6 +327,36 @@ class Trainer:
                 log.info("WXE: loaded consensus weights for %d videos",
                          len(consensus_weights))
 
+        # Explicit shard assignment (--data_shards/--data_shard_id,
+        # data/sharding.py) replaces the implicit process-strided split
+        # for the TRAINING stream: the shard's identity comes from
+        # config, and N shards partition each epoch's global shuffle
+        # exactly.  None (the default) keeps the legacy per-process
+        # split.  Val/eval loaders keep process striding either way —
+        # gather_strided_predictions reconstructs shards from process
+        # topology, a PUBLIC contract this plane does not touch.
+        shard_spec = resolve_shard_spec(
+            int(getattr(opt, "data_shards", 0) or 0),
+            int(getattr(opt, "data_shard_id", 0) or 0))
+        if shard_spec is not None and jax.process_count() > 1:
+            # Identical argv on every host would make ALL processes
+            # consume the same shard — shard 0 trained process_count
+            # times, the rest never.  Refuse loudly; the multi-host
+            # launch recipe is one --data_shard_id (or CST_DATA_SHARD_ID)
+            # per host.
+            raise ValueError(
+                "--data_shards with multiple JAX processes needs a "
+                "DISTINCT --data_shard_id (or CST_DATA_SHARD_ID) per "
+                f"host — this launch gave every one of the "
+                f"{jax.process_count()} processes shard "
+                f"{shard_spec.shard_id}, which would duplicate it and "
+                "drop the rest; either assign per-host shard ids or "
+                "drop --data_shards for the process-strided split")
+        self._telemetry.registry.set_meta("data_plane", {
+            "loader_workers": int(getattr(opt, "loader_workers", 1) or 1),
+            "data_shards": int(getattr(opt, "data_shards", 0) or 0),
+            "data_shard_id": int(getattr(opt, "data_shard_id", 0) or 0),
+        })
         self.loader = CaptionLoader(
             self.train_ds,
             batch_size=opt.batch_size,
@@ -333,8 +364,9 @@ class Trainer:
             shuffle=True,
             seed=opt.seed,
             consensus_weights=consensus_weights,
-            process_index=jax.process_index(),
-            process_count=jax.process_count(),
+            shard_spec=shard_spec,
+            process_index=0 if shard_spec is not None else jax.process_index(),
+            process_count=1 if shard_spec is not None else jax.process_count(),
             # RewardComputer keeps its own tokenized reference corpus, so
             # per-batch gts assembly would be dead work even in RL.
             include_gts=False,
@@ -1189,6 +1221,9 @@ class Trainer:
             device_put=lambda x: jax.device_put(x, self._batch_sharding),
             feat_dtype=self._feat_dtype(),
             telemetry=self._telemetry,
+            # --loader_workers N: assembler threads + ordered reassembly;
+            # the consumed stream is bit-identical at any worker count.
+            workers=int(getattr(opt, "loader_workers", 1) or 1),
         ))
         total_steps = opt.max_epochs * bpe
         best = self.ckpt.infos.get("best_score")

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Summarize a data-plane feed-probe JSON line into a terminal table.
+
+Reads the one-JSON-line artifact ``bench.py --stage data`` prints (from
+stdin, a file, or the newest BENCH_TPU_CACHE entry) and renders the
+input-path picture a human wants at a glance:
+
+  python bench.py --stage data | python scripts/data_report.py
+  python scripts/data_report.py --file data.json
+  python scripts/data_report.py --cache        # last cached device run
+
+Exit 1 (the taxonomy's EXIT_FAILURE) when:
+- no data-feed record could be found/parsed, or the probe measured
+  nothing (value null) — a silent report would hide a broken probe;
+- the record carries a single-worker twin, ran >= 4 workers, and the
+  multi-worker feed rate did not sustain >= MIN_SPEEDUP_AT_4 x the twin
+  — the multi-worker data plane's acceptance gate (ISSUE 15).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cst_captioning_tpu.resilience.exitcodes import (  # noqa: E402
+    EXIT_FAILURE,
+    EXIT_OK,
+)
+
+DATA_METRIC = "data_feed_captions_per_sec"
+
+#: The acceptance gate: at >= 4 workers the probe must sustain at least
+#: this multiple of its single-worker twin's feed rate.
+MIN_SPEEDUP_AT_4 = 2.0
+
+
+def find_record(args) -> dict | None:
+    """First parseable data-feed JSON line from the chosen source."""
+    if args.cache:
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_CACHE.json")) as f:
+                entry = json.load(f)["entries"].get(DATA_METRIC)
+            return entry and entry.get("result")
+        except (OSError, ValueError, KeyError):
+            return None
+    lines = open(args.file) if args.file else sys.stdin
+    try:
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric") == DATA_METRIC:
+                return rec
+    finally:
+        if args.file:
+            lines.close()
+    return None
+
+
+def fmt(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.2f}{unit}"
+    return f"{v}{unit}"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--file", default=None,
+                   help="read the bench JSON line from this file "
+                        "(default: stdin)")
+    p.add_argument("--cache", action="store_true",
+                   help="render the last cached device record instead")
+    args = p.parse_args(argv)
+
+    rec = find_record(args)
+    if rec is None:
+        print("data_report: no data-feed record found "
+              f"(metric {DATA_METRIC!r})", file=sys.stderr)
+        return EXIT_FAILURE
+    if rec.get("value") is None:
+        print("data_report: record carries no measurement (value=null; "
+              f"error={rec.get('error')!r})", file=sys.stderr)
+        return EXIT_FAILURE
+
+    rows = [
+        ("feed rate", fmt(rec.get("value"), " caps/s")),
+        ("batches/s", fmt(rec.get("batches_per_sec"))),
+        ("vs 30k caps/s XE rate", fmt(rec.get("vs_xe_rate"), "x")),
+        ("loader workers", fmt(rec.get("loader_workers"))),
+        ("data shards", f"{fmt(rec.get('data_shard_id'))} of "
+                        f"{fmt(rec.get('data_shards'))}"
+         if rec.get("data_shards") else "unsharded"),
+        ("simulated read latency", fmt(rec.get("read_ms"), " ms/batch")),
+        ("data_wait share @ paced consumer",
+         fmt(rec.get("data_wait_share"))),
+        ("data_wait p99", fmt(rec.get("data_wait_ms_p99"), " ms")),
+        ("queue depth (mean/cap)",
+         f"{fmt(rec.get('queue_depth_mean'))} / "
+         f"{fmt(rec.get('queue_capacity'))}"),
+        ("retries", fmt(rec.get("retries"))),
+        ("platform", f"{rec.get('platform')}"
+         + (" (cpu fallback)" if rec.get("cpu_fallback") else "")),
+    ]
+    twin = rec.get("single_worker_captions_per_sec")
+    if twin is not None:
+        rows.insert(2, ("single-worker twin", fmt(twin, " caps/s")))
+        rows.insert(3, ("multi-worker speedup",
+                        fmt(rec.get("workers_speedup"), "x")))
+    width = max(len(r[0]) for r in rows)
+    print("data-plane feed probe")
+    for k, v in rows:
+        print(f"  {k:<{width}}  {v}")
+
+    rc = EXIT_OK
+    workers = int(rec.get("loader_workers") or 1)
+    speedup = rec.get("workers_speedup")
+    if twin is not None and workers >= 4:
+        if speedup is None or speedup < MIN_SPEEDUP_AT_4:
+            print(f"data_report: GATE FAILED — {workers} workers "
+                  f"sustained {fmt(speedup, 'x')} of the single-worker "
+                  f"feed rate (need >= {MIN_SPEEDUP_AT_4}x); the "
+                  "multi-worker data plane is not paying",
+                  file=sys.stderr)
+            rc = EXIT_FAILURE
+        else:
+            print(f"  gate: {workers} workers >= {MIN_SPEEDUP_AT_4}x "
+                  "single-worker feed rate — ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

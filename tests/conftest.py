@@ -48,6 +48,15 @@ os.environ["CST_SERVE_DEADLINE_MS"] = ""
 os.environ["CST_SERVE_CACHE"] = ""
 os.environ["CST_SERVE_REPLICAS"] = ""
 
+# Data-plane env knobs (ISSUE 15): an operator's exported worker count or
+# shard assignment (opts.py resolves CST_LOADER_WORKERS/CST_DATA_SHARDS/
+# CST_DATA_SHARD_ID as argparse defaults) must not change what the suite
+# pins.  '' falls back to the built-in defaults; data-plane tests pass
+# explicit values instead.
+os.environ["CST_LOADER_WORKERS"] = ""
+os.environ["CST_DATA_SHARDS"] = ""
+os.environ["CST_DATA_SHARD_ID"] = ""
+
 import jax  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu", (

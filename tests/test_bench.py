@@ -151,6 +151,29 @@ def test_stage_xe_isolates():
     assert out["value"] > 0
 
 
+def test_stage_data_feed_probe_record():
+    """ISSUE 15: the data-plane feed probe enters the one-JSON-line
+    contract with the worker/shard identity axes AND the same
+    cpu_fallback/probe provenance fields the training stages carry —
+    plus the single-worker twin + speedup record data_report gates on."""
+    out = run_bench("--stage", "data", "--cache", "0",
+                    "--loader_workers", "2", "--data_videos", "8",
+                    "--data_batches", "4", "--data_read_ms", "1")
+    assert out["metric"] == "data_feed_captions_per_sec"
+    assert out["value"] > 0
+    assert out["unit"] == "captions/s"
+    assert out["loader_workers"] == 2
+    assert out["data_shards"] == 0
+    assert out["read_ms"] == 1.0
+    # provenance like the training stages (satellite): explicit
+    # cpu_fallback + tuned-config fields, never implied
+    assert out["cpu_fallback"] is False
+    assert "tuned" in out
+    assert out["vs_baseline"] == out["vs_xe_rate"]
+    assert out["single_worker_captions_per_sec"] > 0
+    assert out["workers_speedup"] > 0
+
+
 def _run_wedged(platform):
     """Run bench with a child_timeout far below what even tiny shapes need
     to import jax and compile -> the measurement child is ALWAYS killed

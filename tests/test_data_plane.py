@@ -1,0 +1,467 @@
+"""Sharded multi-worker data plane (ISSUE 15).
+
+Tier-1 fast slice, sanitizer-armed like the serving suites:
+
+- shard math: N shards partition every epoch's global shuffle EXACTLY
+  (no dup, no drop), deterministically, at any shard count;
+- the bit-identity contracts: a multi-worker prefetch stream equals the
+  single-thread stream batch for batch, and a sharded skip_batches
+  resume equals its uninterrupted twin bit-exactly (the PR 4 RNG-replay
+  discipline under sharding);
+- chaos: a transient ``loader_err`` inside ONE worker retries the same
+  plan without reordering or dropping batches (stream still equals the
+  fault-free twin); exhausted retries poison the stream in order; the
+  abandon path reaps every ``loader-prefetch-*`` thread;
+- telemetry: queue depth/capacity gauges + per-worker retry counters
+  declared at 0 and riding the heartbeat payload;
+- opts: type-validator usage errors + env fallbacks for
+  --loader_workers/--data_shards/--data_shard_id;
+- the feed probe (``make data-bench``'s API twin) and
+  scripts/data_report.py's render + >= 2x-at-4-workers gate;
+- bench config identity: the data stage's worker/shard/latency axes.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data.bench import SyntheticFeedDataset, feed_probe
+from cst_captioning_tpu.data.loader import (
+    CaptionLoader,
+    prefetch_to_device,
+)
+from cst_captioning_tpu.data.sharding import (
+    ShardSpec,
+    global_epoch_order,
+    resolve_shard_spec,
+    shard_epoch_order,
+    shard_size,
+)
+from cst_captioning_tpu.opts import parse_opts
+from cst_captioning_tpu.resilience.faults import FaultPlan
+from cst_captioning_tpu.telemetry import Telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """ISSUE 11 discipline: the data-plane fast slice runs sanitizer-
+    armed so the new ``data.loader.plan``/``data.loader.queue`` locks
+    are runtime-validated under every multi-worker test."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt: {receipt.read_text()}")
+
+
+def tiny_ds(num_videos=12, **kw):
+    kw.setdefault("seq_len", 8)
+    kw.setdefault("captions_per_video", 4)
+    kw.setdefault("vocab", 50)
+    kw.setdefault("feat_shapes", ((3, 6), (1, 4)))
+    return SyntheticFeedDataset(num_videos, **kw)
+
+
+def assert_batches_equal(a, b):
+    assert a.video_ids == b.video_ids
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    np.testing.assert_array_equal(a.video_ix, b.video_ix)
+    assert len(a.feats) == len(b.feats)
+    for fa, fb in zip(a.feats, b.feats):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+class TestShardSpec:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShardSpec(0, 0)
+        with pytest.raises(ValueError):
+            ShardSpec(3, 3)
+        with pytest.raises(ValueError):
+            ShardSpec(3, -1)
+        assert ShardSpec(3, 2).shard_id == 2
+
+    def test_resolve(self):
+        assert resolve_shard_spec(0, 0) is None
+        assert resolve_shard_spec(4, 1) == ShardSpec(4, 1)
+
+    def test_shard_size_matches_order(self):
+        for n in (7, 12, 13):
+            for s in (1, 2, 3, 5):
+                for k in range(s):
+                    spec = ShardSpec(s, k)
+                    assert shard_size(n, spec) == len(
+                        shard_epoch_order(n, 0, 0, spec))
+
+
+class TestShardUnion:
+    def test_shards_partition_every_epoch_exactly(self):
+        """THE union contract: N shards of one epoch are the N strided
+        slices of ONE global permutation — no video duplicated, none
+        dropped, at any shard count, every epoch."""
+        for n_shards in (1, 2, 3, 5):
+            for epoch in range(3):
+                parts = [
+                    shard_epoch_order(13, 7, epoch, ShardSpec(n_shards, k))
+                    for k in range(n_shards)
+                ]
+                union = np.concatenate(parts)
+                assert sorted(union.tolist()) == list(range(13)), (
+                    f"shards={n_shards} epoch={epoch}: not a partition")
+
+    def test_deterministic_and_epoch_varying(self):
+        a = global_epoch_order(20, 3, 1)
+        b = global_epoch_order(20, 3, 1)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, global_epoch_order(20, 3, 2))
+        assert not np.array_equal(a, global_epoch_order(20, 4, 1))
+
+    def test_unshuffled_shard_is_strided_identity(self):
+        order = shard_epoch_order(10, 0, 5, ShardSpec(3, 1), shuffle=False)
+        np.testing.assert_array_equal(order, np.arange(10)[1::3])
+
+
+class TestOptsFlags:
+    def test_loader_workers_zero_is_usage_error(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            parse_opts(["--loader_workers", "0"])
+        assert e.value.code == 2
+        assert "--loader_workers" in capsys.readouterr().err
+
+    def test_shard_id_out_of_range_is_usage_error(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            parse_opts(["--data_shards", "3", "--data_shard_id", "3"])
+        assert e.value.code == 2
+        assert "0 <= id < --data_shards" in capsys.readouterr().err
+
+    def test_shard_id_without_shards_is_usage_error(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            parse_opts(["--data_shard_id", "1"])
+        assert e.value.code == 2
+        assert "--data_shards >= 1" in capsys.readouterr().err
+
+    def test_defaults(self):
+        ns = parse_opts([])
+        assert ns.loader_workers == 1
+        assert ns.data_shards == 0
+        assert ns.data_shard_id == 0
+
+    def test_env_fallbacks(self, monkeypatch):
+        monkeypatch.setenv("CST_LOADER_WORKERS", "5")
+        monkeypatch.setenv("CST_DATA_SHARDS", "4")
+        monkeypatch.setenv("CST_DATA_SHARD_ID", "2")
+        ns = parse_opts([])
+        assert ns.loader_workers == 5
+        assert ns.data_shards == 4
+        assert ns.data_shard_id == 2
+        # explicit flag beats env
+        assert parse_opts(["--loader_workers", "2"]).loader_workers == 2
+
+    def test_malformed_env_is_usage_error(self, monkeypatch, capsys):
+        monkeypatch.setenv("CST_LOADER_WORKERS", "many")
+        with pytest.raises(SystemExit) as e:
+            parse_opts([])
+        assert e.value.code == 2
+        assert "CST_LOADER_WORKERS" in capsys.readouterr().err
+
+
+class TestShardedLoader:
+    def test_sharded_epoch_covers_dataset_exactly(self):
+        ds = tiny_ds(12)
+        seen = []
+        for k in range(3):
+            loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=9,
+                                   shard_spec=ShardSpec(3, k))
+            for _ in range(2):  # 4 videos per shard / batch 2
+                seen.extend(loader.next_batch().video_ids)
+        assert sorted(seen) == sorted(ds.video_ids)
+
+    def test_shard_spec_excludes_process_striding(self):
+        with pytest.raises(ValueError):
+            CaptionLoader(tiny_ds(12), batch_size=2,
+                          shard_spec=ShardSpec(2, 0), process_count=2)
+
+    def test_sharded_stream_deterministic(self):
+        ds = tiny_ds(10)
+        a = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=4,
+                          shard_spec=ShardSpec(2, 1))
+        b = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=4,
+                          shard_spec=ShardSpec(2, 1))
+        for _ in range(7):
+            assert_batches_equal(a.next_batch(), b.next_batch())
+
+    def test_sharded_resume_twin_bit_identical(self):
+        """The acceptance drill's loader half: a sharded stream resumed
+        via skip_batches equals its uninterrupted twin bit-exactly —
+        the global shuffle consumes no caption-RNG draws, so the PR 4
+        replay discipline holds under any shard count."""
+        ds = tiny_ds(11)
+        for spec in (None, ShardSpec(1, 0), ShardSpec(3, 2)):
+            twin = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=5,
+                                 shard_spec=spec)
+            resumed = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=5,
+                                    shard_spec=spec)
+            ref = [twin.next_batch() for _ in range(9)]
+            resumed.skip_batches(4)
+            for i in range(4, 9):
+                assert_batches_equal(ref[i], resumed.next_batch())
+
+
+class TestMultiWorkerPrefetch:
+    def test_bit_identical_to_single_thread(self):
+        """THE multi-worker contract: batch order and content identical
+        to the single-thread stream, at any worker count."""
+        ds = tiny_ds(10)
+        for workers in (2, 4):
+            ref = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=6)
+            par = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=6)
+            it = prefetch_to_device(par, size=3, workers=workers)
+            for _ in range(12):
+                assert_batches_equal(ref.next_batch(), next(it))
+            it.close()
+
+    def test_sharded_multiworker_resume_twin(self):
+        """Shards + workers + resume composed: the resumed multi-worker
+        stream equals the uninterrupted single-thread twin."""
+        ds = tiny_ds(12)
+        spec = ShardSpec(2, 1)
+        twin = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=8,
+                             shard_spec=spec)
+        ref = [twin.next_batch() for _ in range(8)]
+        resumed = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=8,
+                                shard_spec=spec)
+        resumed.skip_batches(3)
+        it = prefetch_to_device(resumed, size=2, workers=3)
+        for i in range(3, 8):
+            assert_batches_equal(ref[i], next(it))
+        it.close()
+
+    def test_device_put_and_feat_dtype_applied(self):
+        import ml_dtypes
+        import jax.numpy as jnp
+
+        ds = tiny_ds(8)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=1)
+        it = prefetch_to_device(loader, size=2, workers=2,
+                                device_put=jnp.asarray,
+                                feat_dtype=ml_dtypes.bfloat16)
+        b = next(it)
+        assert isinstance(b.labels, jnp.ndarray)
+        assert b.feats[0].dtype == jnp.bfloat16
+        it.close()
+
+    def test_worker_fault_retries_without_reorder_or_drop(self):
+        """Chaos satellite: a transient loader_err inside ONE worker is
+        retried by re-assembling the SAME plan — the stream stays
+        bit-identical to the fault-free twin (nothing reordered,
+        nothing dropped), the retry lands in the global counter AND
+        exactly one per-worker counter."""
+        ds = tiny_ds(10)
+        ref = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=2)
+        faulty = CaptionLoader(ds, batch_size=3, seq_per_img=2, seed=2,
+                               fault_plan=FaultPlan.parse(
+                                   "loader_err@batch=2"))
+        telemetry = Telemetry()
+        it = prefetch_to_device(faulty, size=3, workers=3,
+                                telemetry=telemetry)
+        for _ in range(8):
+            assert_batches_equal(ref.next_batch(), next(it))
+        it.close()
+        reg = telemetry.registry
+        assert reg.counter("loader_retries") == 1
+        per_worker = [reg.counter(f"loader_retries_worker{i}")
+                      for i in range(3)]
+        assert sorted(per_worker) == [0, 0, 1]
+
+    def test_exhausted_retries_raise_in_order(self):
+        """A persistently failing read poisons the stream AT ITS SEQ:
+        every earlier batch is still delivered, then the error raises."""
+
+        class FlakyDS:
+            def __init__(self, inner, bad_after):
+                self._inner = inner
+                self._reads = 0
+                self._bad_after = bad_after
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def features(self, ix):
+                self._reads += 1
+                if self._reads > self._bad_after:
+                    raise OSError("dead transport")
+                return self._inner.features(ix)
+
+        ds = FlakyDS(tiny_ds(10), bad_after=3)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=3)
+        it = prefetch_to_device(loader, size=2, workers=2, retries=1,
+                                retry_backoff_s=0.001)
+        got = 0
+        with pytest.raises(OSError):
+            for _ in range(10):
+                next(it)
+                got += 1
+        assert got >= 1  # earlier batches delivered before the poison
+        it.close()
+
+    def test_abandon_reaps_all_workers(self):
+        """Abandoning the stream joins every loader-prefetch-* thread —
+        no stray worker (or the prefetched buffer it holds) survives."""
+        ds = tiny_ds(10)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=4)
+        it = prefetch_to_device(loader, size=4, workers=4)
+        next(it)
+        next(it)
+        it.close()  # break / GeneratorExit path
+        stray = [t.name for t in threading.enumerate()
+                 if t.name.startswith("loader-prefetch")]
+        assert stray == [], f"stray prefetch threads: {stray}"
+
+    def test_queue_gauges_and_declared_counters(self):
+        """Satellite: queue depth/capacity gauges + per-worker retry
+        counters declared at 0, all visible in the heartbeat payload
+        (between-steps state, not just end-of-run counters)."""
+        ds = tiny_ds(8)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=5)
+        telemetry = Telemetry()
+        it = prefetch_to_device(loader, size=3, workers=2,
+                                telemetry=telemetry)
+        next(it)
+        hb = telemetry.registry.heartbeat_payload()
+        assert "loader_queue_depth" in hb["gauges"]
+        assert hb["gauges"]["loader_queue_capacity"] == 3
+        assert hb["counters"]["loader_retries"] == 0
+        assert hb["counters"]["loader_retries_worker0"] == 0
+        assert hb["counters"]["loader_retries_worker1"] == 0
+        it.close()
+
+    def test_single_thread_path_gains_queue_gauge(self):
+        ds = tiny_ds(8)
+        loader = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=5)
+        telemetry = Telemetry()
+        it = prefetch_to_device(loader, size=2, telemetry=telemetry)
+        next(it)
+        assert "loader_queue_depth" in (
+            telemetry.registry.heartbeat_payload()["gauges"])
+        it.close()
+
+    def test_plain_iterator_falls_back_to_single_thread(self):
+        ds = tiny_ds(8)
+        ref = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=7)
+        src = CaptionLoader(ds, batch_size=2, seq_per_img=2, seed=7)
+        it = prefetch_to_device(iter(src), size=2, workers=4)
+        for _ in range(3):
+            assert_batches_equal(ref.next_batch(), next(it))
+        it.close()
+
+
+class TestFeedProbe:
+    def test_probe_record_fields(self):
+        rec = feed_probe(batch_size=2, seq_per_img=2, seq_len=8, vocab=50,
+                         num_videos=8, workers=2, read_ms=0.5, batches=6,
+                         warmup=2, feat_shapes=((2, 4), (1, 3)))
+        assert rec["captions_per_sec"] > 0
+        assert rec["batches_per_sec"] > 0
+        assert rec["loader_workers"] == 2
+        assert rec["data_shards"] == 0
+        assert 0 <= rec["data_wait_share"] <= 1
+        assert rec["queue_depth_mean"] >= 0
+        assert rec["retries"] == 0
+        assert rec["vs_xe_rate"] == pytest.approx(
+            rec["captions_per_sec"] / 30447.0, abs=1e-3)
+
+    def test_probe_sharded(self):
+        rec = feed_probe(batch_size=2, seq_per_img=2, seq_len=8, vocab=50,
+                         num_videos=10, workers=1, data_shards=2,
+                         data_shard_id=1, read_ms=0.0, batches=4,
+                         warmup=1, feat_shapes=((2, 4),))
+        assert rec["data_shards"] == 2
+        assert rec["data_shard_id"] == 1
+        assert rec["captions_per_sec"] > 0
+
+
+def _report_main(tmp_path, rec):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import data_report
+    finally:
+        sys.path.pop(0)
+    f = tmp_path / "rec.json"
+    f.write_text(json.dumps(rec) + "\n")
+    return data_report.main(["--file", str(f)])
+
+
+class TestDataReport:
+    BASE = {"metric": "data_feed_captions_per_sec", "value": 1000.0,
+            "batches_per_sec": 10.0, "vs_xe_rate": 0.03,
+            "loader_workers": 4, "data_shards": 0, "data_shard_id": 0,
+            "read_ms": 2.0, "data_wait_share": 0.1,
+            "data_wait_ms_p99": 1.0, "queue_depth_mean": 1.5,
+            "queue_capacity": 4, "retries": 0, "platform": "cpu",
+            "single_worker_captions_per_sec": 400.0,
+            "workers_speedup": 2.5}
+
+    def test_renders_and_passes_gate(self, tmp_path, capsys):
+        assert _report_main(tmp_path, dict(self.BASE)) == 0
+        out = capsys.readouterr().out
+        assert "feed rate" in out
+        assert "2.50x" in out
+
+    def test_gate_fails_below_2x_at_4_workers(self, tmp_path, capsys):
+        rec = dict(self.BASE, workers_speedup=1.4,
+                   single_worker_captions_per_sec=714.0)
+        assert _report_main(tmp_path, rec) == 1
+        assert "GATE FAILED" in capsys.readouterr().err
+
+    def test_no_gate_below_4_workers(self, tmp_path):
+        rec = dict(self.BASE, loader_workers=2, workers_speedup=1.4)
+        assert _report_main(tmp_path, rec) == 0
+
+    def test_missing_record_exits_1(self, tmp_path):
+        assert _report_main(tmp_path, {"metric": "other"}) == 1
+
+    def test_null_value_exits_1(self, tmp_path):
+        assert _report_main(tmp_path, dict(self.BASE, value=None)) == 1
+
+
+class TestBenchIdentity:
+    def test_data_stage_config_identity_axes(self, monkeypatch):
+        """Satellite: loader_workers/data_shards (and the simulated-
+        latency protocol knobs) join the bench cache-config identity, so
+        records at different data-plane configurations can never share a
+        cache entry."""
+        import bench
+
+        monkeypatch.setattr(sys, "argv", [
+            "bench.py", "--stage", "data", "--loader_workers", "4",
+            "--data_shards", "2", "--data_shard_id", "1",
+            "--data_read_ms", "3.5"])
+        args = bench.parse_args()
+        config = bench.resolved_config(args)
+        assert config["loader_workers"] == 4
+        assert config["data_shards"] == 2
+        assert config["data_shard_id"] == 1
+        assert config["data_read_ms"] == 3.5
+        assert "data_batches" in config and "data_compare" in config
+        # training stages keep their historical identity shape
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--stage", "xe"])
+        assert "loader_workers" not in bench.resolved_config(
+            bench.parse_args())
+
+    def test_headline_metric_registered(self):
+        import bench
+
+        assert bench.HEADLINE_METRIC["data"] == "data_feed_captions_per_sec"
