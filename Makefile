@@ -34,8 +34,8 @@ TRAIN_COMMON = \
 .PHONY: test lint lint-json chaos xe wxe cst cst_scb cst_host eval bench \
         demo trace-demo scale_chain report collect chip_window tune \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
-        serve-chaos serve-fleet-bench serve-fleet-chaos serve-trace-demo \
-        bf16-parity data-bench clean
+        serve-chaos serve-fleet-bench serve-fleet-chaos serve-proc-bench \
+        serve-proc-chaos serve-trace-demo bf16-parity data-bench clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -280,6 +280,33 @@ serve-fleet-chaos:
 	  --serve_blackbox /tmp/cst_serve_fleet_chaos_blackbox.json \
 	  > /tmp/cst_serve_fleet_chaos.json
 	$(PY) scripts/serve_report.py --file /tmp/cst_serve_fleet_chaos.json
+
+# Process-fleet probe (SERVING.md "Process fleet"): the seeded chaos
+# drill through scripts/serve_supervisor.py — 3 real serve.py child
+# processes, SIGKILL replica 1 mid-stream, crash-proof requeue.  The
+# probe itself exits 1 unless every request is answered, captions are
+# bit-identical to the fault-free single-engine reference, surviving
+# children report zero post-warmup compiles, and the killed child's
+# blackbox was harvested into an incident bundle; serve_report re-gates
+# the record (restart budget, bit-identity).
+serve-proc-bench:
+	rm -rf /tmp/cst_supervise && \
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_supervisor.py --serve_demo 1 \
+	  --supervise_probe 1 --supervise_replicas 3 \
+	  --serve_demo_eos_bias -2 --decode_chunk 2 --beam_size 1 \
+	  --supervise_dir /tmp/cst_supervise \
+	  > /tmp/cst_serve_proc.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_proc.json
+
+# Process-fleet chaos drills (SERVING.md "Process fleet", RESILIENCE.md
+# "Process faults"): the full tests/test_supervisor.py suite including
+# the slow real-subprocess drills tier-1 skips (proc_kill requeue,
+# double-SIGTERM supervisor drain, the CLI probe), sanitizer-armed,
+# then the probe + report gates above.
+serve-proc-chaos:
+	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
+	  $(PY) -m pytest tests/test_supervisor.py -q
+	$(MAKE) serve-proc-bench
 
 # Zero-setup request-lifecycle drill (OBSERVABILITY.md "Request
 # lifecycle & flight recorder"): pipe a few requests (plus the

@@ -30,6 +30,10 @@ HOT_PATHS = (
     "cst_captioning_tpu/serving/engine.py",
     "cst_captioning_tpu/serving/server.py",
     "cst_captioning_tpu/serving/fleet.py",
+    # The process-fleet supervisor (ISSUE 16): its tick loop pumps every
+    # child socket and its reader/requeue/health threads must declare
+    # their locks — a missed guard here corrupts requeue bookkeeping.
+    "cst_captioning_tpu/serving/supervisor.py",
     "cst_captioning_tpu/telemetry/lifecycle.py",
     "cst_captioning_tpu/parallel/",
     # The sharded multi-worker data plane (ISSUE 15): the prefetch loop

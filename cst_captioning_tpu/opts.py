@@ -538,6 +538,60 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "same machine-auditable artifact).  Default: "
                         "<checkpoint_path>/telemetry.json in checkpoint "
                         "mode, off in demo mode")
+    g.add_argument("--supervise_replicas",
+                   type=_positive_int(
+                       "--supervise_replicas (or CST_SUPERVISE_REPLICAS)"),
+                   default=os.environ.get("CST_SUPERVISE_REPLICAS") or 3,
+                   help="scripts/serve_supervisor.py: OS-process serve.py "
+                        "replicas under the process-fleet supervisor "
+                        "(serving/supervisor.py) — each a real child "
+                        "process speaking the JSONL wire over its own "
+                        "localhost socket, restarted/retired by the exit "
+                        "taxonomy with crash-proof requeue (SERVING.md "
+                        "'Process fleet').  Env fallback: "
+                        "CST_SUPERVISE_REPLICAS")
+    g.add_argument("--supervise_restart_limit",
+                   type=_nonneg_int(
+                       "--supervise_restart_limit (or "
+                       "CST_SUPERVISE_RESTART_LIMIT)",
+                       "one strike: a replica's first fatal exit "
+                       "removes it"),
+                   default=os.environ.get("CST_SUPERVISE_RESTART_LIMIT")
+                   or 3,
+                   help="fatal child exits (exitcodes classify 'fatal': "
+                        "1, 130, uncatalogued) each supervised replica "
+                        "may spend before it is dead; resumable (75/137/"
+                        "143) and wedge (124) exits restart free with "
+                        "bounded backoff.  All replicas dead = the "
+                        "supervisor itself exits 124.  Env fallback: "
+                        "CST_SUPERVISE_RESTART_LIMIT")
+    g.add_argument("--supervise_backoff_ms",
+                   type=_nonneg_int(
+                       "--supervise_backoff_ms (or "
+                       "CST_SUPERVISE_BACKOFF_MS)",
+                       "restarts respawn immediately"),
+                   default=os.environ.get("CST_SUPERVISE_BACKOFF_MS")
+                   or 200,
+                   help="base child-restart backoff (milliseconds): "
+                        "doubles per consecutive death (capped at 25x) "
+                        "and resets when the replica next completes a "
+                        "request.  Env fallback: CST_SUPERVISE_BACKOFF_MS")
+    g.add_argument("--supervise_dir", default=None,
+                   help="scripts/serve_supervisor.py: root directory for "
+                        "per-replica child workdirs (replica<K>/ with "
+                        "blackbox.json, heartbeat.json, telemetry.json, "
+                        "stderr.log) and the incidents/ evidence bundles "
+                        "harvested from dead replicas (RESILIENCE.md "
+                        "'Process faults').  Default: a fresh temp dir")
+    g.add_argument("--supervise_probe", type=int, default=0,
+                   help="1 = scripts/serve_supervisor.py runs the seeded "
+                        "process-chaos drill instead of serving: N "
+                        "replicas, proc_kill@replica=1 mid-stream, every "
+                        "request answered, captions checked bit-identical "
+                        "against a fault-free single-engine reference, "
+                        "zero post-warmup compiles per surviving child, "
+                        "blackbox harvested from the killed replica; "
+                        "emits the benchmark record line")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:
@@ -715,7 +769,8 @@ def _explicit_flags(argv: Optional[Sequence[str]]) -> set:
     """
     aux = argparse.ArgumentParser(add_help=False, fromfile_prefix_chars="@")
     for axis in ("decode_chunk", "scan_unroll", "overlap_rewards",
-                 "device_rewards", "decode_kernel"):
+                 "device_rewards", "decode_kernel", "serve_replicas",
+                 "supervise_replicas"):
         aux.add_argument(f"--{axis}", default=argparse.SUPPRESS)
     try:
         ns, _ = aux.parse_known_args(argv)
@@ -860,6 +915,33 @@ def warn_serve_deadline(ns: argparse.Namespace) -> None:
               file=sys.stderr)
 
 
+_warned_supervise_conflict = False
+
+
+def warn_supervise_conflict(ns: argparse.Namespace,
+                            argv: Optional[Sequence[str]] = None) -> None:
+    """--serve_replicas (the IN-PROCESS fleet, scripts/serve_fleet.py)
+    and --supervise_replicas (the OS-PROCESS fleet, scripts/
+    serve_supervisor.py) size different topologies; each front end reads
+    only its own knob.  Both set explicitly in one invocation almost
+    always means the operator grabbed the wrong flag — ONE stderr line
+    naming which knob this front end honors (the --overlap_rewards
+    warn-once pattern), not silence and not an error."""
+    global _warned_supervise_conflict
+    if _warned_supervise_conflict:
+        return
+    if argv is None:
+        argv = sys.argv[1:]
+    explicit = _explicit_flags(argv)
+    if "serve_replicas" in explicit and "supervise_replicas" in explicit:
+        _warned_supervise_conflict = True
+        print("warning: both --serve_replicas (in-process fleet, "
+              "serve_fleet.py) and --supervise_replicas (OS-process "
+              "fleet, serve_supervisor.py) are set; each front end "
+              "honors only its own flag — the other is ignored",
+              file=sys.stderr)
+
+
 def _validate_shard_flags(parser: argparse.ArgumentParser,
                           ns: argparse.Namespace) -> None:
     """Cross-field shard validation as a one-line usage error (the
@@ -882,6 +964,7 @@ def parse_opts(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     _validate_shard_flags(parser, ns)
     apply_tuned_defaults(ns, argv)
     _warn_overlap_under_device_rewards(ns, argv)
+    warn_supervise_conflict(ns, argv)
     if getattr(ns, "engine", "legacy") == "serving":
         warn_serving_decode_chunk(ns)
         warn_serve_deadline(ns)

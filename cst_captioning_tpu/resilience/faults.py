@@ -21,7 +21,11 @@ Grammar (comma-separated specs)::
     kind@replica=K     fleet serving only: fire once inside replica K's
                        engine, at that engine's first opportunity for the
                        kind (serving kinds only; the router materializes
-                       it via :meth:`FaultPlan.for_replica`)
+                       it via :meth:`FaultPlan.for_replica`).  The
+                       process-level kinds (``proc_*``) ONLY use this
+                       axis: they name an OS-process replica and are
+                       fired by the fleet supervisor, never inside an
+                       engine (:meth:`FaultPlan.fire_replica`)
     kind@step=N*K      fire on steps N, N+1, ..., N+K-1 (K consecutive)
 
 Registered kinds and the index they key on:
@@ -53,6 +57,15 @@ kind             keys on  effect at the injection site
 ``admit_err``    req      raise a transient error from request N's admission
                           (the engine must re-queue and retry, never drop
                           the request silently or kill the scheduler loop)
+``proc_kill``    replica  SIGKILL replica K's serve.py process mid-work —
+                          the supervisor must requeue its in-flight
+                          requests and restart it (exit 137, resumable)
+``proc_wedge``   replica  SIGSTOP replica K's process — it goes silent with
+                          work owed; the wedge timeout must turn this into
+                          a kill classified as exit 124 (wedge)
+``proc_preempt`` replica  SIGTERM replica K's process — its own drain
+                          contract completes residents, rejects its queue
+                          (the supervisor requeues those), and exits 75
 ===============  =======  ===================================================
 
 Firing is deterministic and single-shot per (kind, index): a plan replayed
@@ -89,6 +102,13 @@ KINDS: Dict[str, str] = {
     "serve_garble": "req",
     "admit_err": "req",
     "serve_cache": "req",
+    # Process failure domain (RESILIENCE.md "Process faults"): keyed on
+    # the OS-process replica the fleet supervisor owns.  Never threaded
+    # into an engine — the supervisor delivers these as real signals
+    # (serving/supervisor.py).
+    "proc_kill": "replica",
+    "proc_wedge": "replica",
+    "proc_preempt": "replica",
 }
 
 #: Serving kinds that may ALTERNATIVELY target a fleet replica
@@ -97,6 +117,12 @@ KINDS: Dict[str, str] = {
 #: fires at the first index probed for that kind — single-shot, like
 #: every other spec (RESILIENCE.md "Serving faults").
 REPLICA_KINDS = frozenset(k for k, axis in KINDS.items() if axis == "req")
+
+#: Process-level kinds: ``@replica=K`` is their ONLY axis.  Fired by the
+#: process-fleet supervisor via :meth:`FaultPlan.fire_replica` (a signal
+#: to the child OS process); never forwarded into an engine's plan and
+#: never forwarded onto a child's command line.
+PROC_KINDS = frozenset(k for k, axis in KINDS.items() if axis == "replica")
 
 #: Sentinel ``FaultSpec.at``: the spec covers ANY index (used by the
 #: per-replica plans ``for_replica`` derives from ``@replica=K`` specs).
@@ -202,10 +228,11 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault kind {kind!r}; registered: {sorted(KINDS)}")
             if axis == "replica":
-                if kind not in REPLICA_KINDS:
+                if kind not in REPLICA_KINDS and kind not in PROC_KINDS:
                     raise ValueError(
                         f"fault {kind!r} cannot target a fleet replica; "
-                        f"@replica=K is valid for {sorted(REPLICA_KINDS)}")
+                        f"@replica=K is valid for "
+                        f"{sorted(REPLICA_KINDS | PROC_KINDS)}")
                 if m.group("times"):
                     raise ValueError(
                         f"bad fault spec {raw!r}: @replica=K takes no "
@@ -236,18 +263,40 @@ class FaultPlan:
         derived plan, so its consumed set survives the restart — the
         single-shot-across-resumes discipline ``fire`` has for
         rollbacks, without which a replica-targeted fault would re-fire
-        on every restart and burn the whole restart budget."""
+        on every restart and burn the whole restart budget.  ``proc_*``
+        kinds are NOT materialized: they act on the replica's OS process
+        from outside (``fire_replica``), not inside its engine."""
         k = int(replica)
         if k in self._derived:
             return self._derived[k]
         specs = [FaultSpec(s.kind, ANY_INDEX) for s in self.specs
-                 if s.replica == k]
+                 if s.replica == k and s.kind not in PROC_KINDS]
         derived: Optional[FaultPlan] = None
         if specs:
             derived = FaultPlan(specs=specs)
             derived._metrics = self._metrics
         self._derived[k] = derived
         return derived
+
+    def _consume(self, kind: str, key: Tuple[str, int]) -> None:
+        """Shared single-shot bookkeeping for ``fire``/``fire_replica``:
+        mark consumed, persist, count."""
+        self._consumed.add(key)
+        if self._state_path is not None:
+            # Record BEFORE the fault acts: a wedge kills the
+            # process, and the resume attempt must see it spent.
+            try:
+                with open(self._state_path, "a") as f:
+                    # The CONSUMED key (ANY_INDEX for any-index
+                    # specs), so a reload blocks the same spec.
+                    f.write(json.dumps([kind, key[1]]) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                pass
+        if self._metrics is not None:
+            self._metrics.inc("fault_firings")
+            self._metrics.inc(f"fault_{kind}")
 
     def fire(self, kind: str, index: int) -> bool:
         """True exactly once per (kind, index) covered by a spec.  The
@@ -262,32 +311,61 @@ class FaultPlan:
                        else int(index))
                 if key in self._consumed:
                     return False
-                self._consumed.add(key)
-                if self._state_path is not None:
-                    # Record BEFORE the fault acts: a wedge kills the
-                    # process, and the resume attempt must see it spent.
-                    try:
-                        with open(self._state_path, "a") as f:
-                            # The CONSUMED key (ANY_INDEX for any-index
-                            # specs), so a reload blocks the same spec.
-                            f.write(json.dumps([kind, key[1]]) + "\n")
-                            f.flush()
-                            os.fsync(f.fileno())
-                    except OSError:
-                        pass
-                if self._metrics is not None:
-                    self._metrics.inc("fault_firings")
-                    self._metrics.inc(f"fault_{kind}")
+                self._consume(kind, key)
                 log.warning("FAULT INJECTED: %s fired at %s=%d (spec %s)",
                             kind, KINDS[kind], index, spec)
                 return True
         return False
+
+    def fire_replica(self, kind: str, replica: int) -> bool:
+        """True exactly once per (``proc_*`` kind, replica): the
+        process-fleet SUPERVISOR's firing API.  Process-level faults act
+        on replica ``replica``'s OS process from outside (a real signal
+        — serving/supervisor.py probes each armed kind once the replica
+        is mid-work), so they never flow through an engine's ``fire``.
+        Single-shot with the same persisted-consumed-set semantics:
+        a restarted replica does not re-eat its own kill."""
+        if KINDS.get(kind) != "replica":
+            raise ValueError(
+                f"fire_replica is for process-level kinds "
+                f"{sorted(PROC_KINDS)}, not {kind!r}")
+        k = int(replica)
+        for spec in self.specs:
+            if spec.kind == kind and spec.replica == k:
+                key = (kind, k)
+                if key in self._consumed:
+                    return False
+                self._consume(kind, key)
+                log.warning("FAULT INJECTED: %s fired at replica=%d "
+                            "(spec %s)", kind, k, spec)
+                return True
+        return False
+
+    def cli_for_child(self, replica: int) -> Optional[str]:
+        """The ``--fault_plan`` string a process-fleet supervisor passes
+        to replica ``replica``'s serve.py child: every SERVING
+        ``kind@replica=K`` spec targeting this replica becomes
+        ``kind@req=0`` — the child's first submitted request, the
+        process-boundary analogue of the any-index firing
+        :meth:`for_replica` hands an in-process engine (a fresh child's
+        first request IS its first opportunity for the kind).  ``proc_*``
+        kinds are NOT forwarded — the supervisor itself delivers them as
+        signals.  None when nothing serving-level targets this replica
+        (the child runs fault-free)."""
+        k = int(replica)
+        specs = [f"{s.kind}@req=0" for s in self.specs
+                 if s.replica == k and s.kind in REPLICA_KINDS]
+        return ",".join(specs) or None
 
     def pending(self, kind: str) -> int:
         """Indices of ``kind`` armed but not yet consumed (test assertions)."""
         n = 0
         for spec in self.specs:
             if spec.kind != kind:
+                continue
+            if spec.kind in PROC_KINDS and spec.replica is not None:
+                # Process-level specs consume a (kind, replica) key.
+                n += int((kind, spec.replica) not in self._consumed)
                 continue
             n += sum(1 for i in range(spec.at, spec.at + spec.times)
                      if (kind, i) not in self._consumed)

@@ -28,7 +28,14 @@ discipline of PAPERS.md arXiv 2603.09555):
   replicas (shared ProgramCache/result cache, route-around-degraded,
   draining rotation, supervised replica restart with resident re-queue,
   fleet-edge deadline shed) speaking the engine's scheduler surface so
-  ``server.py`` drives a fleet unchanged (``scripts/serve_fleet.py``).
+  ``server.py`` drives a fleet unchanged (``scripts/serve_fleet.py``);
+- ``policy.py``   — the placement/health policy shared by both fleets:
+  status ranking, replica ordering, and the deadline-unmeetable floor;
+- ``supervisor.py`` — the OS-process fleet: N real ``scripts/serve.py``
+  children on localhost sockets, lifecycle driven by the exit taxonomy
+  (resumable restart + crash-proof requeue, fatal restart budget,
+  wedge kill), stream-prefix watermarks across requeue, and blackbox
+  harvest from dead replicas (``scripts/serve_supervisor.py``).
 
 Architecture, bucket policy, and the drain contract: SERVING.md.
 """
@@ -42,7 +49,11 @@ _LAZY = {"Completion": ".engine", "Request": ".engine",
          "ServingEngine": ".engine", "serve_decode_split": ".engine",
          "CaptionServer": ".server", "serving_probe": ".bench",
          "FleetRouter": ".fleet", "FleetUnrecoverable": ".fleet",
-         "FLEET_COUNTERS": ".fleet"}
+         "FLEET_COUNTERS": ".fleet",
+         "ProcessFleetSupervisor": ".supervisor",
+         "SupervisorServer": ".supervisor",
+         "SupervisorUnrecoverable": ".supervisor",
+         "SUPERVISOR_COUNTERS": ".supervisor"}
 
 
 def __getattr__(name):

@@ -1479,6 +1479,7 @@ class ServingEngine:
         within ``degraded_window_s``) plus queue depth and the recovery
         counters.  Host state only: safe to call from the watchdog's
         heartbeat payload while the scheduler may be wedged."""
+        floor = self.min_service_s()
         return {
             "status": health_status(draining=False,
                                     recovering=self.degraded()),
@@ -1488,6 +1489,14 @@ class ServingEngine:
             "completed": self._completed,
             "recovery": self.recovery_counters(),
             "compiles": self._cache.builds,
+            # The shed floor (one p99 chunk; None until the latency
+            # window is honest), in ms so it travels the health WIRE:
+            # the process-fleet supervisor reads every child's floor
+            # from {"op": "health"} for the fleet-edge deadline shed —
+            # the same policy the in-process router applies via
+            # min_service_s() (serving/policy.deadline_unmeetable).
+            "min_service_ms": (None if floor is None
+                               else round(floor * 1e3, 3)),
         }
 
     # -- telemetry ---------------------------------------------------------

@@ -85,6 +85,8 @@ import jax
 from ..utils.locksan import declare_order, named_lock
 from .engine import (Completion, Dropped, Request, ServingEngine,
                      ServingUnrecoverable, StreamChunk)
+from .policy import (STATUS_RANK, deadline_unmeetable, rank_key,
+                     worst_status)
 
 log = logging.getLogger("cst_captioning_tpu.serving.fleet")
 
@@ -100,11 +102,10 @@ FLEET_COUNTERS = ("fleet_routed", "fleet_rerouted", "fleet_shed",
 LOCK_ORDER = ("serving.fleet.health", "telemetry.registry")
 declare_order(*LOCK_ORDER)
 
-#: Worst-of ordering for the fleet health status (SERVING.md "Fleet"):
-#: a rotating replica makes the honest worst-of view ``draining``; the
-#: per-replica detail disambiguates.  ``dead`` replicas rank as
-#: ``degraded`` fleet-wide (capacity lost, the survivors still serve).
-_STATUS_RANK = {"ok": 0, "degraded": 1, "draining": 2}
+#: Worst-of ordering for the fleet health status: now the shared
+#: :mod:`serving.policy` table (the process-fleet supervisor ranks with
+#: the same one); kept under the old private name for in-tree readers.
+_STATUS_RANK = STATUS_RANK
 
 
 class FleetUnrecoverable(RuntimeError):
@@ -232,10 +233,13 @@ class FleetRouter:
 
         def key(rep: Replica):
             # Cheap property reads, not engine.health() — this ranking
-            # runs once per routed request (cstlint HOT_PATHS).
+            # runs once per routed request (cstlint HOT_PATHS).  The
+            # key itself is the shared policy (serving/policy.py), so
+            # the process-fleet supervisor places identically.
             eng = rep.engine
-            return (1 if eng.degraded() else 0,
-                    eng.queue_depth + eng.resident_count, rep.index)
+            return rank_key(eng.degraded(),
+                            eng.queue_depth + eng.resident_count,
+                            rep.index)
 
         return sorted(active, key=key)
 
@@ -274,9 +278,8 @@ class FleetRouter:
         ttl = (self.deadline_ms if deadline_ms is None
                else float(deadline_ms))
         if ttl and ttl > 0:
-            floors = [rep.engine.min_service_s() for rep in cands]
-            if all(f is not None for f in floors) \
-                    and ttl / 1e3 < min(floors):
+            if deadline_unmeetable(
+                    ttl, (rep.engine.min_service_s() for rep in cands)):
                 # Provably unmeetable EVERYWHERE: shed at the edge, with
                 # an explicit answer — never a silent loss, never a
                 # queue slot wasted at a replica.
@@ -721,11 +724,7 @@ class FleetRouter:
         engines."""
         with self._health_lock:
             per = [dict(s) for s in self._snapshots]
-        ranks = [_STATUS_RANK.get(s["status"],
-                                  _STATUS_RANK["degraded"])  # dead et al.
-                 for s in per]
-        worst = max(ranks) if ranks else _STATUS_RANK["degraded"]
-        status = next(k for k, v in _STATUS_RANK.items() if v == worst)
+        status = worst_status(s["status"] for s in per)  # dead -> degraded
         return {
             "status": status,
             "replicas": len(per),

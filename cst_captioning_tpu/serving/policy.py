@@ -1,0 +1,61 @@
+"""Fleet routing policy — the ONE definition, shared across topologies.
+
+The in-process :class:`fleet.FleetRouter` (N engines, one process) and
+the process-fleet supervisor (:mod:`serving.supervisor` — N serve.py OS
+processes over sockets) implement the same serving policies:
+
+- **healthy-tier-first placement** (:func:`rank_key`): candidates sort
+  into the healthy tier before the degraded one, least-loaded within a
+  tier, replica index as the deterministic tiebreak;
+- **worst-of health** (:func:`worst_status`): the fleet's one-word
+  status is its sickest replica's, with per-replica detail alongside;
+- **fleet-edge deadline shed** (:func:`deadline_unmeetable`): a TTL
+  provably below EVERY candidate's p99 service floor is shed at the
+  edge with an explicit answer, before it wastes a queue slot anywhere.
+
+Both routers import these functions rather than re-deriving the policy,
+so a policy change cannot fork the two topologies (SERVING.md "Fleet" /
+"Process fleet").  Pure host code — no jax, importable by a supervisor
+process that never touches an accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+#: Worst-of ordering for the fleet health status (SERVING.md "Fleet"):
+#: a rotating replica makes the honest worst-of view ``draining``; the
+#: per-replica detail disambiguates.  ``dead`` replicas (and any status
+#: outside the table — ``restarting``, ``starting``) rank as
+#: ``degraded`` fleet-wide: capacity lost, the survivors still serve.
+STATUS_RANK = {"ok": 0, "degraded": 1, "draining": 2}
+
+
+def rank_key(degraded: bool, load: int, index: int) -> Tuple[int, int, int]:
+    """Candidate sort key: healthy tier first, least-loaded within a
+    tier, index as the deterministic tiebreak.  ``load`` is whatever the
+    caller can measure cheaply (queue + residents for an in-process
+    engine; the supervisor's own in-flight count over a socket)."""
+    return (1 if degraded else 0, int(load), int(index))
+
+
+def worst_status(statuses: Iterable[str]) -> str:
+    """The fleet's one-word health: the worst replica status under
+    :data:`STATUS_RANK` (unknown statuses rank as ``degraded``); an
+    empty fleet is ``degraded``, never silently ``ok``."""
+    ranks = [STATUS_RANK.get(s, STATUS_RANK["degraded"]) for s in statuses]
+    worst = max(ranks) if ranks else STATUS_RANK["degraded"]
+    return next(k for k, v in STATUS_RANK.items() if v == worst)
+
+
+def deadline_unmeetable(ttl_ms: float,
+                        floors_s: Iterable[Optional[float]]) -> bool:
+    """True when ``ttl_ms`` is provably below every candidate's service
+    floor (one p99 decode chunk, seconds) — the fleet-edge shed test.
+    Conservative: any unknown floor (``None``, a replica whose latency
+    window is not yet honest) makes the answer False — never shed on a
+    guess."""
+    floors = list(floors_s)
+    if not floors or any(f is None for f in floors):
+        return False
+    return float(ttl_ms) / 1e3 < min(floors)

@@ -79,6 +79,21 @@ def main() -> int:
              os.path.join(stage, "infos.json"))
         take(f"{stage}_beam5.json")
 
+    # Process-fleet supervisor evidence (RESILIENCE.md "Process
+    # faults"): the per-child-death incident bundles (blackbox/
+    # heartbeat/telemetry/stderr harvested from the dead replica's
+    # workdir + the incident.json index) and the supervisor's own exit
+    # snapshot.  Only textual forensics are taken — stderr logs travel
+    # because they are the crash's last words.
+    take("supervisor_exit.json")
+    take("blackbox.json", "supervisor_blackbox.json")
+    incidents_root = os.path.join(src, "incidents")
+    if os.path.isdir(incidents_root):
+        for incident in sorted(os.listdir(incidents_root)):
+            for fn in ("incident.json", "blackbox.json",
+                       "heartbeat.json", "telemetry.json", "stderr.log"):
+                take(os.path.join("incidents", incident, fn))
+
     # Regenerate the report against the live out_dir so report + copies
     # agree, then keep both renderings.  A wedged/killed chain_report must
     # degrade to "bundle without report" — the MANIFEST below still gets

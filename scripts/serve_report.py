@@ -141,6 +141,35 @@ def main(argv=None) -> int:
                  f"status {pr.get('status')}, "
                  f"{fmt(pr.get('restarts'))} restart(s) / "
                  f"{fmt(pr.get('kills'))} kill(s)"))
+    sup = rec.get("supervisor") or {}
+    if sup.get("enabled"):
+        sup_killed = sup.get("killed_replica")
+        rows += [
+            ("process fleet",
+             f"{fmt(sup.get('replicas'))} child process(es) — "
+             f"{fmt(sup.get('restarts'))} restart(s), "
+             f"{fmt(sup.get('requeued'))} requeued, "
+             f"{fmt(sup.get('deaths'))} dead "
+             f"(fatal budget {fmt(sup.get('restart_limit'))}, "
+             f"budget_ok={sup.get('budget_ok')})"),
+            ("process incidents",
+             f"{fmt(sup.get('incidents'))} harvested, "
+             f"blackbox_harvested={sup.get('blackbox_harvested')}"
+             + (f" (drill killed replica {sup_killed})"
+                if sup_killed is not None else "")),
+            ("process parity",
+             f"parity_ok={sup.get('parity_ok')} "
+             f"({fmt(sup.get('parity_mismatches'))} caption(s) != the "
+             "single-engine reference)"),
+        ]
+        for pr in sup.get("per_replica") or []:
+            rows.append(
+                (f"  child {pr.get('replica')}",
+                 f"{fmt(pr.get('completed'))} completed, "
+                 f"state {pr.get('state')}, "
+                 f"{fmt(pr.get('restarts'))} restart(s) / "
+                 f"{fmt(pr.get('kills'))} kill(s), "
+                 f"last_rc {fmt(pr.get('last_rc'))}"))
     attribution = rec.get("attribution") or {}
     lifecycle = rec.get("lifecycle") or {}
     if attribution:
@@ -226,6 +255,18 @@ def main(argv=None) -> int:
         print("  !! fleet caption(s) not bit-identical to the fault-free "
               "single-engine reference run: the fleet bit-identity "
               "contract is broken (SERVING.md 'Fleet')", file=sys.stderr)
+        rc = 1
+    if sup.get("enabled") and sup.get("parity_ok") is False:
+        print("  !! process-fleet caption(s) not bit-identical to the "
+              "fault-free single-engine reference run: crash-proof "
+              "requeue re-decoded something differently (SERVING.md "
+              "'Process fleet')", file=sys.stderr)
+        rc = 1
+    if sup.get("enabled") and sup.get("budget_ok") is False:
+        print("  !! a supervised replica exhausted its fatal-exit "
+              "restart budget during the drill: the process fleet is "
+              "losing capacity it should have kept (SERVING.md "
+              "'Process fleet')", file=sys.stderr)
         rc = 1
     if stream.get("enabled") and stream.get("prefix_ok") is False:
         print("  !! streamed chunks are not prefix-consistent with the "

@@ -1,0 +1,501 @@
+#!/usr/bin/env python
+"""Process-fleet serving CLI: N serve.py OS processes under a supervisor.
+
+``scripts/serve_fleet.py`` self-heals N engine replicas inside ONE
+process; this front end moves the failure domain to the OS process — a
+:class:`serving.supervisor.ProcessFleetSupervisor` owns
+``--supervise_replicas`` real ``scripts/serve.py`` child processes (each
+on its own localhost socket, its own workdir for blackbox/heartbeat/
+telemetry/stderr) and proxies the SAME JSONL wire through a
+:class:`serving.supervisor.SupervisorServer`: the wire format,
+streaming, deadlines, and result semantics are unchanged (SERVING.md
+"Process fleet").
+
+    # zero-setup demo process fleet (3 child processes):
+    python scripts/serve_supervisor.py --serve_demo 1 \\
+        --supervise_replicas 3
+
+    # the seeded process-chaos drill (SIGKILL replica 1 mid-stream;
+    # every request answered, captions bit-identical to a fault-free
+    # single-engine reference, blackbox harvested from the dead child):
+    python scripts/serve_supervisor.py --serve_demo 1 \\
+        --supervise_probe 1 --serve_demo_eos_bias -2
+
+Supervisor specifics:
+
+- Child lifecycle is the EXIT TAXONOMY (resilience/exitcodes.py):
+  resumable (75/137/143) and wedge (124) exits restart free with
+  bounded backoff and their in-flight requests requeued (arrival clocks
+  preserved, streams prefix-consistent via supervisor watermarks);
+  fatal exits burn ``--supervise_restart_limit``; when every replica is
+  dead this process exits 124 for supervised restart one level up.
+- Every child death leaves an incident bundle under
+  ``<--supervise_dir>/incidents/`` — ``{"op": "dump"}`` is issued
+  before a deliberate kill so blackbox.json exists to harvest
+  (RESILIENCE.md "Process faults"; scripts/collect_evidence.py bundles
+  them).
+- ``--fault_plan 'proc_kill@replica=K'`` / ``proc_wedge`` /
+  ``proc_preempt`` target OS-process faults at child K;
+  ``serve_*@replica=K`` serving kinds are forwarded INTO child K's own
+  ``--fault_plan``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from cst_captioning_tpu.opts import parse_opts  # noqa: E402
+
+log = logging.getLogger("cst_captioning_tpu.serve_supervisor")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_METRIC = "serve_captions_per_sec_per_chip"
+
+
+def child_argv(opt, workdir: str, replica: int, plan=None) -> list:
+    """One child's serve.py command line: the parent's serving shape
+    flags forwarded EXPLICITLY (never raw argv — supervisor-only flags
+    must not leak), socket mode on an ephemeral port, every durable
+    artifact routed into the child's own workdir, and child K's slice
+    of the fault plan (``FaultPlan.cli_for_child``)."""
+    argv = [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+            "--serve_port", "-1",
+            "--serve_blackbox", os.path.join(workdir, "blackbox.json"),
+            "--serve_heartbeat_file",
+            os.path.join(workdir, "heartbeat.json"),
+            "--serve_telemetry_file",
+            os.path.join(workdir, "telemetry.json"),
+            "--loglevel", "WARNING"]
+    forward = [("--serve_demo", opt.serve_demo),
+               ("--serve_demo_eos_bias", opt.serve_demo_eos_bias),
+               ("--beam_size", opt.beam_size),
+               ("--max_length", opt.max_length),
+               ("--length_norm", opt.length_norm),
+               ("--decode_chunk", getattr(opt, "decode_chunk", 8)),
+               ("--serve_buckets", opt.serve_buckets),
+               ("--serve_queue_limit", opt.serve_queue_limit),
+               ("--serve_deadline_ms", opt.serve_deadline_ms),
+               ("--serve_cache", opt.serve_cache),
+               ("--serve_recover", opt.serve_recover),
+               ("--serve_retry_limit", opt.serve_retry_limit),
+               ("--serve_rebuild_limit", opt.serve_rebuild_limit),
+               ("--serve_step_budget_ms", opt.serve_step_budget_ms),
+               ("--serve_lifecycle", opt.serve_lifecycle),
+               ("--serve_lifecycle_events", opt.serve_lifecycle_events),
+               ("--wedge_timeout", opt.wedge_timeout),
+               ("--compile_cache_dir",
+                getattr(opt, "compile_cache_dir", ""))]
+    for flag, val in forward:
+        argv += [flag, str(val)]
+    if not opt.serve_demo:
+        argv += ["--checkpoint_path", opt.checkpoint_path,
+                 "--test_label_h5", str(opt.test_label_h5),
+                 "--test_info_json", str(opt.test_info_json)]
+        argv += ["--test_feat_h5"] + [str(p) for p in opt.test_feat_h5]
+        if opt.test_cocofmt_file:
+            argv += ["--test_cocofmt_file", str(opt.test_cocofmt_file)]
+    if plan is not None:
+        child_plan = plan.cli_for_child(replica)
+        if child_plan:
+            argv += ["--fault_plan", child_plan]
+    return argv
+
+
+def make_launcher(opt, root: str, plan=None):
+    """The supervisor's child factory: replica K lives in
+    ``<root>/replica<K>/``; a RESTART reuses the same workdir (the
+    incident harvest already copied the previous life's evidence)."""
+    from cst_captioning_tpu.serving.supervisor import spawn_serve_child
+
+    def launcher(replica: int):
+        workdir = os.path.join(root, f"replica{replica}")
+        os.makedirs(workdir, exist_ok=True)
+        return spawn_serve_child(
+            child_argv(opt, workdir, replica, plan=plan),
+            workdir, replica, env=dict(os.environ))
+
+    return launcher
+
+
+def build_supervisor(opt, root: str, *, plan=None, registry=None,
+                     lifecycle=None):
+    from cst_captioning_tpu.serving.supervisor import ProcessFleetSupervisor
+
+    return ProcessFleetSupervisor(
+        make_launcher(opt, root, plan=plan), opt.supervise_replicas,
+        restart_limit=opt.supervise_restart_limit,
+        backoff_ms=opt.supervise_backoff_ms,
+        wedge_timeout_s=opt.wedge_timeout,
+        incident_dir=os.path.join(root, "incidents"),
+        fault_plan=plan, registry=registry, lifecycle=lifecycle)
+
+
+def write_supervisor_exit(root: str, rc: int, sup, registry) -> None:
+    """The supervisor's own exit snapshot (the train.py discipline):
+    final stats + fleet health + registry telemetry, atomically, where
+    collect_evidence finds it next to the incident bundles."""
+    from cst_captioning_tpu.resilience.integrity import atomic_json_write
+
+    try:
+        atomic_json_write(
+            os.path.join(root, "supervisor_exit.json"),
+            {"rc": rc, "stats": sup.stats(),
+             "health": sup.health_payload(),
+             "telemetry": registry.snapshot()}, indent=2)
+    except OSError as e:
+        print(f"serve_supervisor: exit snapshot write failed: {e}",
+              file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# the seeded process-chaos drill (--supervise_probe 1)
+# ---------------------------------------------------------------------------
+
+
+def _single_engine_reference(opt, root: str, video_ids) -> dict:
+    """The fault-free twin: ONE serve.py child, no fault plan, each
+    unique video captioned once — the bit-identity reference."""
+    from cst_captioning_tpu.serving.supervisor import spawn_serve_child
+
+    workdir = os.path.join(root, "reference")
+    os.makedirs(workdir, exist_ok=True)
+    child = spawn_serve_child(child_argv(opt, workdir, 0, plan=None),
+                              workdir, 0, env=dict(os.environ))
+    captions = {}
+    try:
+        for i, vid in enumerate(video_ids):
+            child.send_line(json.dumps({"id": f"ref{i}",
+                                        "video_id": vid}))
+        deadline = time.monotonic() + 300.0
+        while len(captions) < len(video_ids):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "reference child timed out with "
+                    f"{len(captions)}/{len(video_ids)} answered")
+            if child.poll() is not None:
+                raise RuntimeError(
+                    f"reference child exited {child.poll()} early")
+            got = child.lines()
+            if not got:
+                time.sleep(0.01)
+            for raw in got:
+                obj = json.loads(raw)
+                if "caption" in obj:
+                    captions[obj["video_id"]] = obj["caption"]
+    finally:
+        child.terminate()
+        child.close()
+    return captions
+
+
+def run_probe(opt) -> int:
+    """The acceptance drill, machine-checked: SIGKILL one replica
+    mid-stream at ``--supervise_replicas`` children; every request must
+    be answered, captions bit-identical to the fault-free single-engine
+    reference, zero post-warmup compiles per surviving child, and the
+    killed replica's blackbox harvested into an incident bundle.
+    Prints the one-JSON-line record scripts/serve_report.py renders and
+    gates."""
+    from cst_captioning_tpu.resilience.faults import FaultPlan
+    from cst_captioning_tpu.serving.supervisor import SupervisorUnrecoverable
+    from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+    root = opt.supervise_dir or tempfile.mkdtemp(prefix="cst_supervise_")
+    os.makedirs(root, exist_ok=True)
+    plan = FaultPlan.parse(getattr(opt, "fault_plan", None)
+                           or "proc_kill@replica=1")
+    registry = MetricsRegistry()
+    plan.bind_metrics(registry)
+    log.warning("CHAOS: process fault plan armed: %s", plan)
+    killed_replica = next((s.replica for s in plan.specs
+                           if s.kind == "proc_kill"), None)
+
+    num_requests = 18
+    video_ids = [f"v{i % 16}" for i in range(num_requests)]
+    answers: dict = {i: [] for i in range(num_requests)}
+
+    sup = build_supervisor(opt, root, plan=plan, registry=registry)
+    rc = 0
+    try:
+        # Capture every child's post-warm compile baseline BEFORE
+        # traffic (engine.warm() ran before the port announcement, so
+        # anything beyond this baseline is a post-warmup compile).
+        deadline = time.monotonic() + 120.0
+        while any(r.live and r.compiles0 is None for r in sup._replicas):
+            sup.tick()
+            if time.monotonic() > deadline:
+                raise RuntimeError("children never answered health")
+            time.sleep(0.01)
+
+        t0 = time.monotonic()
+        for i, vid in enumerate(video_ids):
+            sup.submit(i, vid, respond=answers[i].append, stream=True)
+        deadline = time.monotonic() + 600.0
+        while sup.outstanding:
+            if not sup.tick():
+                time.sleep(0.005)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"drill timed out with {sup.outstanding} of "
+                    f"{num_requests} unanswered")
+        makespan = time.monotonic() - t0
+
+        # Let the fleet HEAL before judging it: the killed replica's
+        # backoff expires and its restart hatches (seconds of jax
+        # import in the new child) — the record must show the restart
+        # actually happened, not merely that it was scheduled.
+        heal = time.monotonic() + 180.0
+        while not all(r.live for r in sup._replicas):
+            sup.tick()
+            if time.monotonic() > heal:
+                raise RuntimeError(
+                    "fleet never healed: "
+                    + str([r.state for r in sup._replicas]))
+            time.sleep(0.02)
+
+        # Post-drill: zero post-warmup compiles per SURVIVING child
+        # (a restarted child re-warmed before announcing — its own
+        # generation's baseline applies).
+        for k in range(len(sup._replicas)):
+            sup.request_stats(k)
+        settle = time.monotonic() + 30.0
+        while time.monotonic() < settle and any(
+                r.live and r.last_stats is None for r in sup._replicas):
+            sup.tick()
+            time.sleep(0.01)
+        recompiles = 0
+        for rep in sup._replicas:
+            if not rep.live or rep.compiles0 is None:
+                continue
+            now_c = (rep.last_stats or rep.health or {}).get("compiles")
+            if now_c is not None:
+                recompiles += max(0, int(now_c) - int(rep.compiles0))
+
+        finals = {}
+        prefix_ok = True
+        chunks_total = 0
+        completed = 0
+        for i in range(num_requests):
+            terminal = [a for a in answers[i]
+                        if a.get("final") or "error" in a]
+            assert len(terminal) == 1, (
+                f"request {i} got {len(terminal)} terminals: "
+                f"{answers[i]}")
+            fin = terminal[0]
+            if "caption" in fin:
+                completed += 1
+                finals[i] = fin["caption"]
+                chunks = [a for a in answers[i]
+                          if a.get("stream") and not a.get("final")]
+                chunks_total += len(chunks)
+                seqs = [c["seq"] for c in chunks]
+                text = " ".join(c["text"] for c in chunks
+                                if c["text"]).strip()
+                if seqs != list(range(len(seqs))) \
+                        or text != fin["caption"]:
+                    prefix_ok = False
+
+        reference = _single_engine_reference(
+            opt, root, sorted(set(video_ids)))
+        mismatches = sum(
+            1 for i, cap in finals.items()
+            if reference.get(video_ids[i]) != cap)
+        parity_ok = (completed == num_requests and mismatches == 0)
+
+        stats = sup.stats()
+        c = stats["supervisor"]
+        incidents = stats["incidents"]
+        blackbox_harvested = any(
+            "blackbox.json" in (inc.get("files") or [])
+            for inc in incidents
+            if killed_replica is None
+            or inc.get("replica") == killed_replica)
+        budget_ok = c["sup_replica_deaths"] == 0
+        lat = [stats.get("latency_p50_ms"), stats.get("latency_p99_ms")]
+
+        record = {
+            "metric": SERVE_METRIC, "schema": 1,
+            "value": round(completed / makespan, 2) if makespan else None,
+            "platform": "cpu" if os.environ.get(
+                "JAX_PLATFORMS") == "cpu" else "supervised",
+            "completed": completed, "num_requests": num_requests,
+            "shed": c["sup_shed"], "makespan_s": round(makespan, 3),
+            "latency_p50_ms": lat[0], "latency_p99_ms": lat[1],
+            "beam_size": opt.beam_size,
+            "decode_chunk": getattr(opt, "decode_chunk", 8),
+            "buckets": opt.serve_buckets,
+            "recompiles_after_warmup": recompiles,
+            "stream": {"enabled": True, "prefix_ok": prefix_ok,
+                       "chunks": chunks_total},
+            "supervisor": {
+                "enabled": True,
+                "replicas": opt.supervise_replicas,
+                "restart_limit": opt.supervise_restart_limit,
+                "killed_replica": killed_replica,
+                "restarts": c["sup_replica_restarts"],
+                "requeued": c["sup_requeued"],
+                "deaths": c["sup_replica_deaths"],
+                "wedge_kills": c["sup_wedge_kills"],
+                "budget_ok": budget_ok,
+                "parity_ok": parity_ok,
+                "parity_mismatches": mismatches,
+                "incidents": len(incidents),
+                "blackbox_harvested": blackbox_harvested,
+                "per_replica": stats["per_replica"],
+            },
+        }
+        print(json.dumps(record))
+        report = {
+            "answered": completed == num_requests,
+            "parity_ok": parity_ok, "prefix_ok": prefix_ok,
+            "recompiles": recompiles, "budget_ok": budget_ok,
+            "blackbox_harvested": blackbox_harvested,
+        }
+        print(f"serve_supervisor: probe {json.dumps(report)}",
+              file=sys.stderr)
+        if not all([report["answered"], parity_ok, prefix_ok,
+                    recompiles == 0, blackbox_harvested]):
+            rc = 1
+    except SupervisorUnrecoverable as e:
+        from cst_captioning_tpu.resilience.exitcodes import (EXIT_WEDGE,
+                                                             describe)
+
+        print(f"serve_supervisor: UNRECOVERABLE: {e}; exiting "
+              f"{EXIT_WEDGE} ({describe(EXIT_WEDGE)})", file=sys.stderr)
+        rc = EXIT_WEDGE
+    finally:
+        sup.shutdown()
+        write_supervisor_exit(root, rc, sup, registry)
+        print("serve_supervisor: " + json.dumps(sup.supervisor_counters()),
+              file=sys.stderr)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# serving mode
+# ---------------------------------------------------------------------------
+
+
+def run_serving(opt) -> int:
+    from cst_captioning_tpu.resilience.faults import FaultPlan
+    from cst_captioning_tpu.resilience.preemption import PreemptionHandler
+    from cst_captioning_tpu.serving.supervisor import (SupervisorServer,
+                                                       SupervisorUnrecoverable)
+    from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+    handler = PreemptionHandler().install()
+    registry = MetricsRegistry()
+    plan = FaultPlan.parse(getattr(opt, "fault_plan", None))
+    if plan is not None:
+        plan.bind_metrics(registry)
+        log.warning("CHAOS: process fault plan armed: %s", plan)
+
+    root = opt.supervise_dir or tempfile.mkdtemp(prefix="cst_supervise_")
+    os.makedirs(root, exist_ok=True)
+
+    # The supervisor's OWN flight recorder: intake/route/requeue/
+    # terminal events per request, dumped by the {"op": "dump"} wire op
+    # and the hard-abort/124 paths — the children each run their own.
+    lifecycle = None
+    if opt.serve_lifecycle:
+        from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+        lifecycle = LifecycleTracer(opt.serve_lifecycle_events,
+                                    registry=registry)
+
+    sup = build_supervisor(opt, root, plan=plan, registry=registry,
+                           lifecycle=lifecycle)
+    blackbox = (os.path.join(root, "blackbox.json")
+                if opt.serve_blackbox else None)
+    server = SupervisorServer(sup, handler=handler, registry=registry,
+                              lifecycle=lifecycle, blackbox_path=blackbox)
+    if lifecycle is not None:
+        lifecycle.attach(
+            health=server.health_payload,
+            counters=lambda: registry.snapshot().get("counters"))
+
+    watchdog = None
+    if opt.serve_heartbeat_file or opt.wedge_timeout > 0:
+        from cst_captioning_tpu.utils.watchdog import ProgressWatchdog
+
+        watchdog = ProgressWatchdog(
+            opt.wedge_timeout,
+            describe=lambda: "supervisor scheduler loop",
+            heartbeat_path=opt.serve_heartbeat_file,
+            payload=lambda: {"serving": server.health_payload(),
+                             **registry.heartbeat_payload()},
+            heartbeat_interval_s=1.0).start()
+        server.watchdog = watchdog
+    rc = 0
+    try:
+        try:
+            if opt.serve_port:
+                port = 0 if opt.serve_port < 0 else opt.serve_port
+                rc = server.run_socket(port)
+            else:
+                rc = server.run_stdin()
+        except SupervisorUnrecoverable as e:
+            from cst_captioning_tpu.resilience.exitcodes import (
+                EXIT_WEDGE,
+                describe,
+            )
+
+            print(f"serve_supervisor: UNRECOVERABLE: {e}; exiting "
+                  f"{EXIT_WEDGE} ({describe(EXIT_WEDGE)})",
+                  file=sys.stderr)
+            if lifecycle is not None and blackbox:
+                try:
+                    lifecycle.dump(blackbox, reason="unrecoverable")
+                    print(f"serve_supervisor: blackbox written to "
+                          f"{blackbox}", file=sys.stderr)
+                except OSError as werr:
+                    print(f"serve_supervisor: blackbox write failed: "
+                          f"{werr}", file=sys.stderr)
+            sup.hard_abort()
+            rc = EXIT_WEDGE
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        stats = sup.stats()
+        print("serve_supervisor: " + json.dumps(stats), file=sys.stderr)
+        if opt.result_file:
+            from cst_captioning_tpu.resilience.integrity import (
+                atomic_json_write,
+            )
+
+            atomic_json_write(opt.result_file,
+                              {"stats": stats,
+                               "health": sup.health_payload(),
+                               "telemetry": registry.snapshot()},
+                              indent=2)
+        write_supervisor_exit(root, rc, sup, registry)
+    return rc
+
+
+def main(argv=None) -> int:
+    opt = parse_opts(argv)
+    from cst_captioning_tpu.utils.platform import configure_cli_logging
+
+    configure_cli_logging(opt.loglevel)
+    # No jax import in THIS process — the supervisor is pure host code;
+    # every accelerator touch happens inside the serve.py children.
+    if not opt.serve_demo and not opt.test_feat_h5:
+        print("serve_supervisor.py: checkpoint mode needs "
+              "--test_feat_h5/--test_label_h5/--test_info_json (or pass "
+              "--serve_demo 1)", file=sys.stderr)
+        return 2
+    if opt.supervise_probe:
+        return run_probe(opt)
+    return run_serving(opt)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,15 @@ os.environ["CST_SERVE_DEADLINE_MS"] = ""
 os.environ["CST_SERVE_CACHE"] = ""
 os.environ["CST_SERVE_REPLICAS"] = ""
 
+# Process-fleet supervisor env knobs (ISSUE 16): an operator's exported
+# replica count / restart budget / backoff base (opts.py resolves
+# CST_SUPERVISE_* as argparse defaults) must not change what the suite
+# pins.  '' falls back to the built-in defaults; supervisor tests pass
+# explicit values instead.
+os.environ["CST_SUPERVISE_REPLICAS"] = ""
+os.environ["CST_SUPERVISE_RESTART_LIMIT"] = ""
+os.environ["CST_SUPERVISE_BACKOFF_MS"] = ""
+
 # Data-plane env knobs (ISSUE 15): an operator's exported worker count or
 # shard assignment (opts.py resolves CST_LOADER_WORKERS/CST_DATA_SHARDS/
 # CST_DATA_SHARD_ID as argparse defaults) must not change what the suite

@@ -1,0 +1,971 @@
+"""Process-fleet supervisor (ISSUE 16): OS-process replica lifecycle
+driven by the exit taxonomy, crash-proof requeue, blackbox harvest.
+
+Fast slice (tier-1, lock-sanitizer armed, NO jax import — the
+supervisor is pure host code and these tests keep it that way):
+- the shared routing policy (serving/policy.py) driving placement:
+  healthy-tier-first, least-loaded, index tiebreak, route-around-
+  ``degraded``, fleet-edge deadline shed with an explicit answer;
+- THE lifecycle drill against a strict in-process fake child (a fake
+  whose ``lines()`` never advances work after death — a dead child
+  cannot answer): SIGKILL mid-stream -> in-flight requeued with the
+  ARRIVAL clock preserved (remaining TTL forwarded), captions
+  bit-identical to the fault-free twin, stream chunks prefix-consistent
+  through the supervisor watermark (every token exactly once, ``seq``
+  re-issued contiguously);
+- the exit taxonomy as policy: resumable (143) restarts burn NO budget;
+  fatal (1) exits burn ``restart_limit`` and escalate to
+  :class:`SupervisorUnrecoverable` when every replica is dead; bounded
+  exponential backoff that doubles per consecutive death and resets on
+  the next healthy completion;
+- child-level ``shed``/``rejected_draining`` answers rerouted/requeued
+  (the client never sees a drain it did not cause), parking while every
+  replica is mid-restart, wedge detection killing a line-silent child
+  as exit 124;
+- ``proc_kill``/``proc_wedge``/``proc_preempt`` fault kinds firing
+  exactly once, "mid-work" (in-flight + at least one response line),
+  with dump-before-kill landing blackbox.json in the incident bundle;
+- the aggregated health plane (worst-of-replicas, restarts/backoff
+  folded in), the SupervisorServer wire (health/stats/dump/bad lines),
+  drain/hard-abort semantics, opts flags/env/warn-once, serve_report's
+  process-fleet rows + gates, and the SERVING.md/RESILIENCE.md pins.
+
+The real-subprocess drills (the seeded SIGKILL acceptance probe through
+``scripts/serve_supervisor.py --supervise_probe`` and the double-SIGTERM
+abort drill) are marked ``slow`` and run via ``make serve-proc-chaos``.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from cst_captioning_tpu.resilience.exitcodes import (
+    EXIT_PREEMPTED,
+    EXIT_SIGKILL,
+    EXIT_SIGTERM,
+    EXIT_WEDGE,
+)
+from cst_captioning_tpu.resilience.faults import FaultPlan
+from cst_captioning_tpu.serving.policy import (
+    deadline_unmeetable,
+    rank_key,
+    worst_status,
+)
+from cst_captioning_tpu.serving.supervisor import (
+    SUPERVISOR_COUNTERS,
+    ProcessFleetSupervisor,
+    SupervisorServer,
+    SupervisorUnrecoverable,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """The supervisor fast slice runs sanitizer-armed (the PR 11/13
+    discipline): scheduler/health/requeue/front-end locks re-validated
+    against the declared LOCK_ORDER under every drill in this file."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt from a child process: "
+        f"{receipt.read_text()}")
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeChild:
+    """A strict serve.py stand-in with the ServeChild surface.  One
+    decode chunk of work advances per ``lines()`` call while the child
+    is alive and unfrozen; after ``die()`` the transport raises and
+    ``lines()`` only returns what was ALREADY buffered — a dead child
+    can never quietly answer its residents (that laxness would let a
+    requeue test pass without requeueing anything)."""
+
+    CHUNK = 2
+    CAP_LEN = 6
+
+    def __init__(self, replica, workdir, *, status="ok", compiles=0,
+                 min_service_ms=1.0, shed_all=False, reject_all=False):
+        self.replica = int(replica)
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.pid = 40000 + self.replica
+        self.alive = True
+        self.frozen = False
+        self.draining = False
+        self.rc = None
+        self.status = status
+        self.compiles = compiles
+        self.min_service_ms = min_service_ms
+        self.shed_all = shed_all
+        self.reject_all = reject_all
+        self.sent = []
+        self.jobs = []
+        self.dumps = 0
+        self._outbox = []
+        self._stalled = []
+
+    # -- the deterministic demo decode ----------------------------------
+
+    @classmethod
+    def tokens_for(cls, vid):
+        base = int(str(vid).lstrip("v"))
+        return [base * 10 + j + 1 for j in range(cls.CAP_LEN)]
+
+    @classmethod
+    def caption_for(cls, vid):
+        return " ".join(f"w{t}" for t in cls.tokens_for(vid))
+
+    # -- the ServeChild surface -----------------------------------------
+
+    def send_line(self, line):
+        if not self.alive:
+            raise OSError("child is dead")
+        if self.frozen:
+            # A SIGSTOP'd process accepts bytes into its socket buffer
+            # but processes nothing: stall the line until cont().
+            self._stalled.append(line)
+            return
+        self.sent.append(line)
+        req = json.loads(line)
+        op = req.get("op", "caption")
+        if op == "health":
+            self._outbox.append(json.dumps({
+                "op": "health", "status": self.status, "queue_depth": 0,
+                "residents": len(self.jobs), "compiles": self.compiles,
+                "min_service_ms": self.min_service_ms}))
+            return
+        if op == "stats":
+            self._outbox.append(json.dumps(
+                {"op": "stats", "compiles": self.compiles}))
+            return
+        if op == "dump":
+            self.dumps += 1
+            with open(os.path.join(self.workdir, "blackbox.json"),
+                      "w") as f:
+                json.dump({"reason": "wire_dump",
+                           "replica": self.replica}, f)
+            self._outbox.append(json.dumps({"op": "dump"}))
+            return
+        rid = req["id"]
+        if self.shed_all:
+            self._outbox.append(json.dumps(
+                {"id": rid, "error": "shed", "queue_depth": 1}))
+            return
+        if self.reject_all or self.draining:
+            self._outbox.append(json.dumps(
+                {"id": rid, "error": "rejected_draining"}))
+            return
+        self.jobs.append({"id": rid, "vid": req["video_id"],
+                          "deadline_ms": req.get("deadline_ms"),
+                          "stream": op == "stream", "pos": 0, "seq": 0})
+
+    def lines(self):
+        if self.alive and not self.frozen:
+            self._advance()
+        out, self._outbox = self._outbox, []
+        return out
+
+    def _advance(self):
+        for job in list(self.jobs):
+            toks = self.tokens_for(job["vid"])
+            if job["pos"] < len(toks):
+                chunk = toks[job["pos"]:job["pos"] + self.CHUNK]
+                if job["stream"]:
+                    self._outbox.append(json.dumps({
+                        "id": job["id"], "video_id": job["vid"],
+                        "stream": True, "seq": job["seq"],
+                        "tokens": chunk,
+                        "text": " ".join(f"w{t}" for t in chunk),
+                        "final": False}))
+                job["seq"] += 1
+                job["pos"] += self.CHUNK
+                continue
+            term = {"id": job["id"], "video_id": job["vid"],
+                    "caption": self.caption_for(job["vid"]),
+                    "tokens": toks, "latency_ms": 7.0}
+            if job["stream"]:
+                term.update(stream=True, final=True, chunks=job["seq"])
+            self._outbox.append(json.dumps(term))
+            self.jobs.remove(job)
+        if self.draining and not self.jobs:
+            self.die(EXIT_PREEMPTED)
+
+    def poll(self):
+        return None if self.alive else self.rc
+
+    def die(self, rc):
+        self.alive = False
+        self.rc = rc
+
+    def terminate(self):
+        if not self.alive:
+            return
+        self.draining = True
+        if not self.jobs:
+            self.die(EXIT_PREEMPTED)
+
+    def kill(self):
+        if self.alive:
+            self.die(EXIT_SIGKILL)
+
+    def stop(self):
+        self.frozen = True
+
+    def cont(self):
+        self.frozen = False
+        stalled, self._stalled = self._stalled, []
+        for line in stalled:
+            self.send_line(line)
+
+    def close(self):
+        pass
+
+
+def build_sup(tmp_path, n=2, **kw):
+    clock = kw.pop("clock", None) or FakeClock()
+    child_kw = kw.pop("child_kw", {})
+    children = []
+
+    def launcher(k):
+        child = FakeChild(k, os.path.join(str(tmp_path), f"replica{k}"),
+                          **child_kw.get(k, {}))
+        children.append(child)
+        return child
+
+    kw.setdefault("backoff_ms", 200.0)
+    kw.setdefault("incident_dir", os.path.join(str(tmp_path),
+                                               "incidents"))
+    sup = ProcessFleetSupervisor(launcher, n, clock=clock,
+                                 spawn_async=False, **kw)
+    return sup, children, clock
+
+
+def child_of(children, k):
+    """The CURRENT (latest-spawned) child of replica k."""
+    return [c for c in children if c.replica == k][-1]
+
+
+def tick_until(sup, pred, n=64):
+    for _ in range(n):
+        sup.tick()
+        if pred():
+            return
+    raise AssertionError(f"predicate never held within {n} ticks")
+
+
+# -- shared policy ---------------------------------------------------------
+
+
+def test_policy_identity_with_fleet_router():
+    """Both fleets import ONE policy: the supervisor's placement order,
+    worst-of health, and deadline shed are serving/policy.py verbatim
+    — spot-check the semantics the supervisor leans on."""
+    assert rank_key(False, 3, 1) < rank_key(True, 0, 0)
+    assert rank_key(False, 1, 2) < rank_key(False, 2, 0)
+    assert worst_status(["ok", "degraded"]) == "degraded"
+    assert worst_status(["ok", "restarting"]) == "degraded"  # unknown
+    assert worst_status([]) == "degraded"
+    assert deadline_unmeetable(10.0, [5.0, 7.0]) is True
+    assert deadline_unmeetable(10.0, [5.0, None]) is False  # never guess
+
+
+def test_placement_spreads_load_then_index(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 3)
+    got = []
+    for i in range(6):
+        sup.submit(i, f"v{i}", respond=got.append)
+    # 0,1,2 then back to 0,1,2: least-loaded within the healthy tier,
+    # index as the tiebreak.
+    owners = [len(c.jobs) for c in children]
+    assert owners == [2, 2, 2]
+    c = sup.supervisor_counters()
+    assert c["sup_requests"] == 6 and c["sup_routed"] == 6
+    assert c["sup_rerouted"] == 0
+
+
+def test_route_around_degraded_child(tmp_path):
+    sup, children, _ = build_sup(
+        tmp_path, 2, child_kw={0: {"status": "degraded"}})
+    sup.tick()   # health poll out
+    sup.tick()   # health replies in
+    got = []
+    sup.submit("a", "v1", respond=got.append)
+    assert len(children[1].jobs) == 1 and not children[0].jobs
+
+
+def test_caption_completes_with_supervisor_edge_latency(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 1)
+    got = []
+    sup.submit("cli-7", "v3", respond=got.append)
+    clock.advance(0.25)
+    tick_until(sup, lambda: got)
+    fin = got[-1]
+    assert fin["id"] == "cli-7"
+    assert fin["caption"] == FakeChild.caption_for("v3")
+    # The child said 7.0ms; the supervisor's answer spans ITS intake.
+    assert fin["latency_ms"] == pytest.approx(250.0)
+    assert sup.outstanding == 0 and sup.quiet
+
+
+# -- THE drill: kill mid-stream, requeue, bit-identity ---------------------
+
+
+def test_kill_midstream_requeues_bit_identical_prefix_consistent(tmp_path):
+    """The in-process acceptance drill: SIGKILL the owner mid-stream —
+    the request is requeued with its arrival clock preserved (remaining
+    TTL forwarded to the new owner), the replayed chunks fall inside
+    the watermark, and the client sees every token exactly once with
+    contiguous supervisor-issued ``seq`` and the bit-identical caption
+    of the fault-free twin."""
+    sup, children, clock = build_sup(tmp_path, 2)
+    got = []
+    sup.submit("s1", "v4", respond=got.append, stream=True,
+               deadline_ms=1000.0)
+    first = json.loads(children[0].sent[-1])
+    assert first["op"] == "stream" and first["deadline_ms"] == 1000.0
+
+    sup.tick()   # chunk 0 (tokens 0-1)
+    sup.tick()   # chunk 1 (tokens 2-3)
+    chunks = [a for a in got if a.get("stream") and not a.get("final")]
+    assert [c["seq"] for c in chunks] == [0, 1]
+
+    clock.advance(0.3)
+    children[0].kill()   # mid-decode: 4 of 6 tokens forwarded
+    sup.tick()           # reap 137 -> requeue to replica 1
+
+    c = sup.supervisor_counters()
+    assert c["sup_requeued"] == 1 and c["sup_rerouted"] == 1
+    # Arrival preserved: the new owner gets the REMAINING TTL.
+    replay = json.loads(children[1].sent[-1])
+    assert replay["op"] == "stream"
+    assert replay["deadline_ms"] == pytest.approx(700.0)
+
+    tick_until(sup, lambda: any(a.get("final") and "caption" in a
+                                for a in got))
+    fin = got[-1]
+    assert fin["caption"] == FakeChild.caption_for("v4")   # bit-identical
+    chunks = [a for a in got if a.get("stream") and not a.get("final")]
+    # Every token exactly once, seq contiguous, text == caption.
+    assert [c["seq"] for c in chunks] == [0, 1, 2]
+    toks = [t for c in chunks for t in c["tokens"]]
+    assert toks == FakeChild.tokens_for("v4")
+    assert " ".join(c["text"] for c in chunks) == fin["caption"]
+    assert fin["chunks"] == 3   # chunks the CLIENT saw, not the child's
+
+    # The dead replica restarts after backoff, free of fatal budget.
+    clock.advance(0.5)
+    sup.tick()
+    rep0 = sup._replicas[0]
+    assert rep0.live and rep0.restarts == 1 and rep0.fatal_spent == 0
+    assert len(sup._incidents) == 1
+    assert sup._incidents[0]["classification"] == "resumable"
+
+
+def test_watermark_slices_mid_chunk(tmp_path):
+    """A replay chunk STRADDLING the watermark is sliced, tokens and
+    text in lockstep (Vocab.decode is one word per non-zero token)."""
+    sup, _, _ = build_sup(tmp_path, 1)
+    got = []
+    sup.submit("s", "v1", respond=got.append, stream=True)
+    pr = next(iter(sup._pending.values()))
+    pr.sent_tokens, pr.cur_tokens, pr.seq_out = 3, 0, 2
+    sup._forward_chunk(pr, {"stream": True, "seq": 0,
+                            "tokens": [11, 12, 13, 14],
+                            "text": "a b c d", "final": False})
+    assert got[-1]["tokens"] == [14] and got[-1]["text"] == "d"
+    assert got[-1]["seq"] == 2 and pr.sent_tokens == 4
+
+
+# -- the exit taxonomy as lifecycle policy ---------------------------------
+
+
+def test_fatal_exits_burn_budget_then_unrecoverable(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 1, restart_limit=1)
+    children[0].die(1)          # fatal
+    sup.tick()
+    rep = sup._replicas[0]
+    assert rep.fatal_spent == 1 and rep.state == "backoff"
+    clock.advance(0.5)
+    sup.tick()                  # restart 1 hatches
+    assert rep.live and rep.restarts == 1
+    child_of(children, 0).die(1)
+    with pytest.raises(SupervisorUnrecoverable):
+        sup.tick()              # budget spent fleet-wide -> 124 upstream
+    assert rep.state == "dead"
+    assert sup.supervisor_counters()["sup_replica_deaths"] == 1
+
+
+def test_resumable_exits_restart_free_of_budget(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 1, restart_limit=0)
+    for _ in range(3):
+        child_of(children, 0).die(EXIT_SIGTERM)
+        sup.tick()
+        clock.advance(3.0)      # never mind the doubling here
+        sup.tick()
+    rep = sup._replicas[0]
+    assert rep.live and rep.restarts == 3 and rep.fatal_spent == 0
+
+
+def test_backoff_doubles_caps_and_resets_on_completion(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 1, backoff_ms=200.0,
+                                     backoff_cap_ms=1000.0)
+    delays = []
+    for _ in range(4):
+        child_of(children, 0).die(EXIT_SIGTERM)
+        sup.tick()
+        delays.append(round((sup._replicas[0].backoff_until - clock.t)
+                            * 1e3))
+        clock.advance(2.0)
+        sup.tick()
+    assert delays == [200, 400, 800, 1000]   # doubling, then the cap
+    got = []
+    sup.submit("a", "v1", respond=got.append)
+    tick_until(sup, lambda: got)             # healthy completion...
+    child_of(children, 0).die(EXIT_SIGTERM)
+    sup.tick()
+    assert round((sup._replicas[0].backoff_until - clock.t) * 1e3) == 200
+
+
+# -- child-level answers routed around -------------------------------------
+
+
+def test_child_shed_reroutes_then_fleet_shed(tmp_path):
+    sup, children, _ = build_sup(
+        tmp_path, 2, child_kw={0: {"shed_all": True}})
+    got = []
+    sup.submit("a", "v1", respond=got.append)
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v1")
+    c = sup.supervisor_counters()
+    assert c["sup_rerouted"] == 1 and c["sup_shed"] == 0
+
+    sup2, _, _ = build_sup(tmp_path / "b", 2,
+                           child_kw={0: {"shed_all": True},
+                                     1: {"shed_all": True}})
+    got2 = []
+    sup2.submit("b", "v2", respond=got2.append)
+    tick_until(sup2, lambda: got2)
+    assert got2[-1]["error"] == "shed"       # honest fleet-edge answer
+    assert sup2.supervisor_counters()["sup_shed"] == 1
+
+
+def test_child_drain_is_requeued_not_leaked_to_client(tmp_path):
+    """A CHILD draining (proc_preempt, an external SIGTERM) while the
+    fleet is not: the client must never see rejected_draining — the
+    request requeues to a live sibling."""
+    sup, children, _ = build_sup(
+        tmp_path, 2, child_kw={0: {"reject_all": True}})
+    got = []
+    sup.submit("a", "v5", respond=got.append)
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v5")
+    assert sup.supervisor_counters()["sup_requeued"] == 1
+
+
+def test_parked_while_every_replica_restarts_then_retried(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 1)
+    children[0].die(EXIT_SIGKILL)
+    sup.tick()                   # backoff; no live replica now
+    got = []
+    sup.submit("a", "v2", respond=got.append, deadline_ms=5000.0)
+    assert not got               # HELD, not shed: a restart is due
+    assert sup.supervisor_counters()["sup_parked"] == 1
+    clock.advance(0.5)
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v2")
+
+
+def test_deadline_unmeetable_shed_at_the_edge(tmp_path):
+    sup, children, _ = build_sup(
+        tmp_path, 2, child_kw={k: {"min_service_ms": 5000.0}
+                               for k in range(2)})
+    sup.tick()
+    sup.tick()                   # health floors in
+    got = []
+    sup.submit("a", "v1", respond=got.append, deadline_ms=10.0)
+    assert got[-1]["error"] == "expired"
+    assert got[-1]["why"] == "deadline_unmeetable"
+    assert not children[0].jobs and not children[1].jobs
+
+
+# -- wedge detection & proc faults -----------------------------------------
+
+
+def test_wedge_detection_kills_silent_child_as_124(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 2, wedge_timeout_s=1.0)
+    got = []
+    sup.submit("a", "v6", respond=got.append, stream=True)
+    children[0].stop()           # frozen: every thread, incl. watchdog
+    sup.tick()
+    clock.advance(1.5)
+    sup.tick()                   # line-silent with work owed -> kill
+    c = sup.supervisor_counters()
+    assert c["sup_wedge_kills"] == 1 and c["sup_requeued"] == 1
+    rep = sup._replicas[0]
+    assert rep.last_rc == EXIT_WEDGE and rep.state == "backoff"
+    assert sup._incidents[0]["classification"] == "wedge"
+    tick_until(sup, lambda: any(a.get("final") for a in got))
+    assert got[-1]["caption"] == FakeChild.caption_for("v6")
+
+
+def test_proc_kill_fires_once_midwork_with_dump_before_kill(tmp_path):
+    plan = FaultPlan.parse("proc_kill@replica=0")
+    sup, children, clock = build_sup(tmp_path, 2, fault_plan=plan,
+                                     dump_grace_s=0.2)
+    for _ in range(3):
+        sup.tick()               # armed but NOT mid-work: never fires
+    assert children[0].alive and children[0].dumps == 0
+
+    got = []
+    sup.submit("a", "v7", respond=got.append, stream=True)
+    tick_until(sup, lambda: not children[0].alive, n=8)
+    assert children[0].dumps == 1          # dump-before-kill
+    assert children[0].rc == EXIT_SIGKILL
+    sup.tick()                             # reap + harvest + requeue
+    assert plan.fire_replica("proc_kill", 0) is False   # single-shot
+    inc = sup._incidents[0]
+    assert inc["rc"] == EXIT_SIGKILL and "blackbox.json" in inc["files"]
+    bb = os.path.join(inc["dir"], "blackbox.json")
+    assert os.path.exists(bb)
+    with open(os.path.join(inc["dir"], "incident.json")) as f:
+        assert json.load(f)["replica"] == 0
+    clock.advance(0.5)
+    tick_until(sup, lambda: any(a.get("final") for a in got))
+    assert got[-1]["caption"] == FakeChild.caption_for("v7")
+
+
+def test_proc_wedge_freezes_until_the_wedge_timer_takes_it(tmp_path):
+    plan = FaultPlan.parse("proc_wedge@replica=0")
+    sup, children, clock = build_sup(tmp_path, 2, fault_plan=plan,
+                                     wedge_timeout_s=1.0)
+    got = []
+    sup.submit("a", "v8", respond=got.append, stream=True)
+    tick_until(sup, lambda: children[0].frozen, n=8)
+    clock.advance(1.5)
+    sup.tick()
+    assert sup._replicas[0].last_rc == EXIT_WEDGE
+    assert sup.supervisor_counters()["sup_wedge_kills"] == 1
+    tick_until(sup, lambda: any(a.get("final") for a in got))
+    assert got[-1]["caption"] == FakeChild.caption_for("v8")
+
+
+def test_proc_preempt_lets_the_child_drain_itself(tmp_path):
+    plan = FaultPlan.parse("proc_preempt@replica=0")
+    sup, children, clock = build_sup(tmp_path, 2, fault_plan=plan)
+    got = []
+    sup.submit("a", "v9", respond=got.append, stream=True)
+    tick_until(sup, lambda: children[0].draining, n=8)
+    # The child's OWN drain contract: the resident completes, then 75.
+    tick_until(sup, lambda: any(a.get("final") for a in got))
+    assert got[-1]["caption"] == FakeChild.caption_for("v9")
+    tick_until(sup, lambda: not children[0].alive, n=8)
+    sup.tick()
+    rep = sup._replicas[0]
+    assert rep.last_rc == EXIT_PREEMPTED and rep.fatal_spent == 0
+    assert sup.supervisor_counters()["sup_requeued"] == 0
+
+
+def test_proc_fault_grammar_and_child_plan_slices():
+    plan = FaultPlan.parse(
+        "proc_kill@replica=1,serve_wedge@replica=1")
+    assert plan.fire_replica("proc_kill", 0) is False
+    assert plan.fire_replica("proc_kill", 1) is True
+    assert plan.fire_replica("proc_kill", 1) is False   # once, ever
+    with pytest.raises(ValueError):
+        plan.fire_replica("serve_wedge", 1)    # not a process-level kind
+    # Serving kinds forward into the CHILD's plan; proc kinds never do.
+    assert plan.cli_for_child(1) == "serve_wedge@req=0"
+    assert plan.cli_for_child(0) is None
+    with pytest.raises(ValueError):
+        FaultPlan.parse("proc_kill@req=3")     # wrong axis for the kind
+
+
+# -- health plane ----------------------------------------------------------
+
+
+def test_health_aggregates_worst_of_and_lifecycle(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 3)
+    sup.tick()
+    sup.tick()
+    h = sup.health_payload()
+    assert h["status"] == "ok" and h["replicas"] == 3
+    assert h["in_service"] == 3 and h["parked"] == 0
+    assert set(SUPERVISOR_COUNTERS) == set(h["supervisor"])
+
+    sup._replicas[1].health = {"status": "degraded"}
+    sup._update_snapshots()
+    assert sup.health_payload()["status"] == "degraded"
+
+    children[2].die(EXIT_SIGKILL)
+    sup.tick()
+    h = sup.health_payload()
+    per = {s["replica"]: s for s in h["per_replica"]}
+    assert per[2]["status"] == "restarting"    # ranks degraded fleet-wide
+    assert h["status"] == "degraded" and h["in_service"] == 2
+
+    st = sup.stats()
+    assert st["replicas"] == 3 and st["in_service"] == 2
+    assert st["supervisor"] == sup.supervisor_counters()
+
+
+# -- drain / abort ---------------------------------------------------------
+
+
+def test_drain_completes_residents_and_rejects_new_work(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 2)
+    got = {0: [], 1: []}
+    sup.submit(0, "v1", respond=got[0].append)
+    sup.submit(1, "v2", respond=got[1].append)
+    sup.begin_drain()
+    tick_until(sup, sup.drain_done)
+    # Residents completed through the children's OWN drain...
+    assert got[0][-1]["caption"] == FakeChild.caption_for("v1")
+    assert got[1][-1]["caption"] == FakeChild.caption_for("v2")
+    # ...their 75 exits are EXPECTED: no incident, no restart.
+    assert not sup._incidents
+    assert all(r.state == "drained" for r in sup._replicas)
+    late = []
+    sup.submit(2, "v3", respond=late.append, stream=True)
+    assert late[-1]["error"] == "rejected_draining"
+    assert late[-1]["final"] is True and late[-1]["stream"] is True
+
+
+def test_hard_abort_answers_every_outstanding_id(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 2)
+    got = {i: [] for i in range(3)}
+    for i in range(3):
+        sup.submit(i, f"v{i}", respond=got[i].append, stream=(i == 0))
+    sup.hard_abort()
+    for i in range(3):
+        assert got[i][-1]["error"] == "rejected_draining"
+    assert got[0][-1]["final"] is True     # streamed terminal invariant
+    assert sup.outstanding == 0
+    assert all(not c.alive for c in children)
+
+
+# -- the SupervisorServer wire ---------------------------------------------
+
+
+def server_rig(tmp_path, n=1, **kw):
+    sup, children, clock = build_sup(tmp_path, n, **kw)
+    server = SupervisorServer(sup, out=io.StringIO())
+    replies = []
+    return sup, children, server, replies, replies.append
+
+
+def test_server_health_stats_dump_ops(tmp_path):
+    sup, children, server, replies, respond = server_rig(tmp_path)
+    server._handle_line('{"op": "health"}', respond)
+    h = json.loads(replies[-1])
+    assert h["op"] == "health" and h["status"] == "ok"
+    server._handle_line('{"op": "stats"}', respond)
+    assert json.loads(replies[-1])["replicas"] == 1
+    server._handle_line('{"op": "dump"}', respond)
+    d = json.loads(replies[-1])
+    # No lifecycle tracer armed on the rig: honest error, children
+    # still asked for THEIR blackboxes.
+    assert d["error"] == "no_recorder" and d["children_asked"] == 1
+    assert children[0].dumps == 1
+
+
+def test_server_hardened_intake(tmp_path):
+    sup, _, server, replies, respond = server_rig(tmp_path)
+    for line, want in [
+            ("not json", "bad_request"),
+            ('["a", "list"]', "bad_request"),
+            ('{"op": "nope", "id": 1}', "unknown_op"),
+            ('{"id": 1}', "bad_request"),                 # no video_id
+            ('{"id": 1, "video_id": "v1", "deadline_ms": -5}',
+             "bad_request")]:
+        server._handle_line(line, respond)
+        assert json.loads(replies[-1])["error"] == want, line
+    assert sup.outstanding == 0
+
+
+def test_server_stdin_front_end_end_to_end(tmp_path):
+    sup, children, clock = build_sup(tmp_path, 2)
+    out = io.StringIO()
+    server = SupervisorServer(sup, out=out, idle_sleep=0.0)
+    lines = [json.dumps({"id": i, "video_id": f"v{i}"}) + "\n"
+             for i in range(4)] + ['{"op": "health"}\n']
+    rc = server.run_stdin(lines=lines)
+    assert rc == 0
+    outs = [json.loads(l) for l in out.getvalue().splitlines()]
+    caps = {o["id"]: o["caption"] for o in outs if "caption" in o}
+    assert caps == {i: FakeChild.caption_for(f"v{i}") for i in range(4)}
+    assert any(o.get("op") == "health" for o in outs)
+    assert all(not c.alive for c in children)   # EOF shutdown drained
+
+
+# -- opts ------------------------------------------------------------------
+
+
+def test_supervise_flags_env_fallback_and_validation(monkeypatch):
+    from cst_captioning_tpu.opts import parse_opts
+
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.supervise_replicas == 3
+    assert ns.supervise_restart_limit == 3
+    assert ns.supervise_backoff_ms == 200
+
+    monkeypatch.setenv("CST_SUPERVISE_REPLICAS", "5")
+    monkeypatch.setenv("CST_SUPERVISE_RESTART_LIMIT", "0")
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.supervise_replicas == 5
+    assert ns.supervise_restart_limit == 0
+    # Explicit flag beats the environment.
+    ns = parse_opts(["--serve_demo", "1", "--supervise_replicas", "2"])
+    assert ns.supervise_replicas == 2
+
+    with pytest.raises(SystemExit):
+        parse_opts(["--supervise_replicas", "0"])      # needs >= 1
+    with pytest.raises(SystemExit):
+        parse_opts(["--supervise_backoff_ms", "-1"])   # needs >= 0
+
+
+def test_supervise_conflict_warns_once(capsys, monkeypatch):
+    from cst_captioning_tpu import opts
+
+    monkeypatch.setattr(opts, "_warned_supervise_conflict", False)
+    opts.parse_opts(["--serve_demo", "1", "--serve_replicas", "2",
+                     "--supervise_replicas", "2"])
+    warned = [l for l in capsys.readouterr().err.splitlines()
+              if "supervise_replicas" in l]
+    assert len(warned) == 1
+    opts.parse_opts(["--serve_demo", "1", "--serve_replicas", "2",
+                     "--supervise_replicas", "2"])
+    assert not capsys.readouterr().err.strip()         # once per process
+
+    monkeypatch.setattr(opts, "_warned_supervise_conflict", False)
+    opts.parse_opts(["--serve_demo", "1", "--supervise_replicas", "2"])
+    assert not capsys.readouterr().err.strip()         # one axis: fine
+
+
+# -- serve_report ----------------------------------------------------------
+
+
+def _run_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def _sup_record(**over):
+    rec = {
+        "metric": "serve_captions_per_sec_per_chip", "value": 12.0,
+        "latency_p50_ms": 40.0, "latency_p99_ms": 90.0, "completed": 18,
+        "num_requests": 18, "shed": 0, "recompiles_after_warmup": 0,
+        "platform": "cpu",
+        "stream": {"enabled": True, "prefix_ok": True, "chunks": 144},
+        "supervisor": {
+            "enabled": True, "replicas": 3, "restart_limit": 3,
+            "killed_replica": 1, "restarts": 1, "requeued": 6,
+            "deaths": 0, "wedge_kills": 0, "budget_ok": True,
+            "parity_ok": True, "parity_mismatches": 0, "incidents": 1,
+            "blackbox_harvested": True,
+            "per_replica": [
+                {"replica": k, "state": "ok", "completed": 6,
+                 "restarts": int(k == 1), "kills": int(k == 1),
+                 "last_rc": 137 if k == 1 else None}
+                for k in range(3)]},
+    }
+    rec["supervisor"].update(over)
+    return rec
+
+
+def test_serve_report_renders_supervisor_rows(tmp_path):
+    proc = _run_report(_sup_record(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "process fleet" in proc.stdout
+    assert "process incidents" in proc.stdout
+    assert "blackbox_harvested=True" in proc.stdout
+    for k in range(3):
+        assert f"child {k}" in proc.stdout
+    assert "budget_ok=True" in proc.stdout
+
+
+def test_serve_report_gates_on_process_parity(tmp_path):
+    proc = _run_report(_sup_record(parity_ok=False,
+                                   parity_mismatches=2), tmp_path)
+    assert proc.returncode == 1
+    assert "bit-identical" in proc.stderr
+
+
+def test_serve_report_gates_on_restart_budget(tmp_path):
+    proc = _run_report(_sup_record(budget_ok=False, deaths=1), tmp_path)
+    assert proc.returncode == 1
+    assert "restart budget" in proc.stderr
+
+
+def test_serve_report_old_records_render_unchanged(tmp_path):
+    rec = {"metric": "serve_captions_per_sec_per_chip", "value": 50.0,
+           "latency_p50_ms": 4.0, "latency_p99_ms": 8.0,
+           "recompiles_after_warmup": 0, "platform": "cpu"}
+    proc = _run_report(rec, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "process fleet" not in proc.stdout
+
+
+# -- doc pins --------------------------------------------------------------
+
+
+def test_serving_doc_pins_supervisor_counter_table():
+    with open(os.path.join(REPO, "SERVING.md")) as f:
+        text = f.read()
+    for name in SUPERVISOR_COUNTERS:
+        assert name in text, f"SERVING.md process-fleet table: {name}"
+    for token in ("serve_supervisor.py", "--supervise_replicas",
+                  "serve-proc-chaos", "supervisor_exit.json"):
+        assert token in text, f"SERVING.md Process fleet: {token!r}"
+
+
+def test_resilience_doc_pins_proc_fault_grammar():
+    with open(os.path.join(REPO, "RESILIENCE.md")) as f:
+        text = f.read()
+    for token in ("proc_kill", "proc_wedge", "proc_preempt",
+                  "incident.json", "incidents/"):
+        assert token in text, f"RESILIENCE.md process faults: {token!r}"
+
+
+# -- slow: the real-subprocess drills --------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_probe_sigkill_drill_end_to_end(tmp_path):
+    """THE acceptance drill through the real CLI: 3 serve.py children,
+    SIGKILL replica 1 mid-stream — every request answered, captions
+    bit-identical to the fault-free single-engine reference, zero
+    post-warmup compiles per surviving child, blackbox harvested from
+    the dead replica, and the record survives serve_report's gates."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = str(tmp_path / "supervise")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_supervisor.py"),
+         "--serve_demo", "1", "--supervise_probe", "1",
+         "--supervise_replicas", "3", "--serve_demo_eos_bias", "-2",
+         "--decode_chunk", "2", "--beam_size", "1",
+         "--supervise_dir", root],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    sup = rec["supervisor"]
+    assert rec["completed"] == rec["num_requests"]
+    assert sup["parity_ok"] and sup["parity_mismatches"] == 0
+    assert sup["requeued"] >= 1 and sup["restarts"] >= 1
+    assert sup["budget_ok"] and sup["deaths"] == 0
+    assert sup["blackbox_harvested"] and sup["incidents"] >= 1
+    assert rec["recompiles_after_warmup"] == 0
+    assert rec["stream"]["prefix_ok"]
+    assert os.path.exists(os.path.join(root, "supervisor_exit.json"))
+    # The record renders and passes serve_report's process gates.
+    report = _run_report(rec, tmp_path)
+    assert report.returncode == 0, report.stderr
+
+
+@pytest.mark.slow
+def test_double_sigterm_supervisor_drill(tmp_path):
+    """The two-signal contract at the SUPERVISOR level: first SIGTERM
+    drains (children run their own drains), a second mid-drain aborts —
+    exit 143, every submitted id answered exactly once (caption or
+    rejected_draining, nothing silent), the supervisor's own blackbox
+    dumped with reason drain_abort.  SIGSTOP/SIGCONT sequence the two
+    signals deterministically."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = str(tmp_path / "supervise")
+    stderr_path = tmp_path / "stderr.log"
+    n = 24
+    with open(stderr_path, "w") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "serve_supervisor.py"),
+             "--serve_demo", "1", "--supervise_replicas", "2",
+             "--serve_demo_eos_bias", "-2", "--decode_chunk", "2",
+             "--beam_size", "1", "--supervise_dir", root,
+             "--loglevel", "WARNING"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=errf,
+            text=True, cwd=REPO, env=env)
+    out_lines = []
+
+    def read_out():
+        for line in proc.stdout:
+            if line.strip():
+                out_lines.append(json.loads(line))
+
+    reader = threading.Thread(target=read_out, daemon=True)
+    reader.start()
+    try:
+        for i in range(n):
+            proc.stdin.write(json.dumps(
+                {"id": i, "video_id": f"v{i % 16}", "op": "stream"})
+                + "\n")
+        proc.stdin.flush()          # stdin stays OPEN: no EOF shutdown
+        deadline = time.monotonic() + 300.0
+        while not out_lines:        # first chunk: the fleet is mid-work
+            assert time.monotonic() < deadline, "no output in 300s"
+            assert proc.poll() is None, stderr_path.read_text()[-4000:]
+            time.sleep(0.01)
+        proc.send_signal(signal.SIGTERM)
+        while "draining" not in stderr_path.read_text():
+            assert time.monotonic() < deadline, "drain never announced"
+            assert proc.poll() is None, stderr_path.read_text()[-4000:]
+            time.sleep(0.005)
+        # Freeze the supervisor, queue the second signal, thaw: the
+        # abort lands at a deterministic point mid-drain.
+        os.kill(proc.pid, signal.SIGSTOP)
+        proc.send_signal(signal.SIGTERM)
+        os.kill(proc.pid, signal.SIGCONT)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdin.close()
+    reader.join(timeout=30)
+    err = stderr_path.read_text()
+    assert rc == EXIT_SIGTERM, err[-4000:]
+    assert "drain aborted" in err
+    terminals = {}
+    for obj in out_lines:
+        if obj.get("final") or "error" in obj:
+            assert obj["id"] not in terminals, f"double answer: {obj}"
+            terminals[obj["id"]] = obj
+    assert set(terminals) == set(range(n)), err[-4000:]
+    kinds = {("caption" if "caption" in t else t["error"])
+             for t in terminals.values()}
+    assert kinds <= {"caption", "rejected_draining"}
+    assert any("error" in t for t in terminals.values()), \
+        "the abort should have left unfinished work answered honestly"
+    bb = os.path.join(root, "blackbox.json")
+    assert os.path.exists(bb)
+    with open(bb) as f:
+        assert json.load(f)["reason"] == "drain_abort"
