@@ -35,7 +35,8 @@ TRAIN_COMMON = \
         demo trace-demo scale_chain report collect chip_window tune \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
         serve-chaos serve-fleet-bench serve-fleet-chaos serve-proc-bench \
-        serve-proc-chaos serve-trace-demo bf16-parity data-bench clean
+        serve-proc-chaos serve-trace-demo fleet-obs-demo bf16-parity \
+        data-bench clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -286,14 +287,17 @@ serve-fleet-chaos:
 # processes, SIGKILL replica 1 mid-stream, crash-proof requeue.  The
 # probe itself exits 1 unless every request is answered, captions are
 # bit-identical to the fault-free single-engine reference, surviving
-# children report zero post-warmup compiles, and the killed child's
-# blackbox was harvested into an incident bundle; serve_report re-gates
-# the record (restart budget, bit-identity).
+# children report zero post-warmup compiles, the killed child's
+# blackbox was harvested into an incident bundle, and no SLO burn-rate
+# alert is left firing (loose objectives armed below — the gate proves
+# the monitor ran, not that the drill was fast); serve_report re-gates
+# the record (restart budget, bit-identity, SLO).
 serve-proc-bench:
 	rm -rf /tmp/cst_supervise && \
 	JAX_PLATFORMS=cpu $(PY) scripts/serve_supervisor.py --serve_demo 1 \
 	  --supervise_probe 1 --supervise_replicas 3 \
 	  --serve_demo_eos_bias -2 --decode_chunk 2 --beam_size 1 \
+	  --slo_p99_ms 60000 --slo_availability 0.5 \
 	  --supervise_dir /tmp/cst_supervise \
 	  > /tmp/cst_serve_proc.json
 	$(PY) scripts/serve_report.py --file /tmp/cst_serve_proc.json
@@ -307,6 +311,30 @@ serve-proc-chaos:
 	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
 	  $(PY) -m pytest tests/test_supervisor.py -q
 	$(MAKE) serve-proc-bench
+
+# Fleet-observability demo (OBSERVABILITY.md "Fleet plane"): the
+# seeded 3-child supervised drill with the scraper on a 200 ms cadence
+# and loose SLO objectives armed, then (1) stitch the supervisor's and
+# every child's trace into ONE clock-skew-corrected Perfetto file
+# (scripts/fleet_trace.py — per-request async tracks cross the process
+# boundary), (2) render it with trace_report's merged-trace view, and
+# (3) gate the scraped series with fleet_report — exit 1 on a burn-rate
+# violation, a scrape blackout, or a replica-slot coverage hole.
+# Artifacts under /tmp/cst_fleet_obs: fleet_trace.json (load in
+# Perfetto), fleet_metrics.jsonl, clock_sync.json, slo_alerts.jsonl,
+# trace/ + replica<K>/trace/.
+fleet-obs-demo:
+	rm -rf /tmp/cst_fleet_obs && \
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_supervisor.py --serve_demo 1 \
+	  --supervise_probe 1 --supervise_replicas 3 \
+	  --serve_demo_eos_bias -2 --decode_chunk 2 --beam_size 1 \
+	  --fleet_scrape_ms 200 --slo_p99_ms 60000 --slo_availability 0.5 \
+	  --supervise_dir /tmp/cst_fleet_obs \
+	  > /tmp/cst_fleet_obs.json
+	$(PY) scripts/fleet_trace.py --dir /tmp/cst_fleet_obs
+	$(PY) scripts/trace_report.py --trace_dir /tmp/cst_fleet_obs
+	$(PY) scripts/fleet_report.py --dir /tmp/cst_fleet_obs
+	$(PY) scripts/serve_report.py --file /tmp/cst_fleet_obs.json
 
 # Zero-setup request-lifecycle drill (OBSERVABILITY.md "Request
 # lifecycle & flight recorder"): pipe a few requests (plus the

@@ -35,6 +35,11 @@ HOT_PATHS = (
     # their locks — a missed guard here corrupts requeue bookkeeping.
     "cst_captioning_tpu/serving/supervisor.py",
     "cst_captioning_tpu/telemetry/lifecycle.py",
+    # The fleet observability plane (ISSUE 17): its scraper runs on the
+    # supervisor's tick thread while reports read the sample ring from
+    # outside — the ring lock and the tick-thread ownership of the
+    # scrape/file state must stay declared.
+    "cst_captioning_tpu/telemetry/fleetobs.py",
     "cst_captioning_tpu/parallel/",
     # The sharded multi-worker data plane (ISSUE 15): the prefetch loop
     # is a per-batch hot path, and its worker threads must obey the

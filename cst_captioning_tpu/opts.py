@@ -313,6 +313,25 @@ def _nonneg_int(flag: str, zero_means: str):
     return parse
 
 
+def _ratio(flag: str, zero_means: str):
+    """argparse type: float in [0, 1) — SLO targets and tolerated
+    fractions (0 is a documented disable; 1.0 would make the error
+    budget zero, so it is rejected too)."""
+
+    def parse(text: str) -> float:
+        try:
+            value = float(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{flag} expects a number, got {text!r}") from None
+        if not (0.0 <= value < 1.0):
+            raise argparse.ArgumentTypeError(
+                f"{flag} must be in [0, 1) (0 = {zero_means}), got {value}")
+        return value
+
+    return parse
+
+
 def _add_decode_args(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("decoding")
     g.add_argument("--beam_size", type=int, default=5,
@@ -592,6 +611,48 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "zero post-warmup compiles per surviving child, "
                         "blackbox harvested from the killed replica; "
                         "emits the benchmark record line")
+    g.add_argument("--fleet_scrape_ms",
+                   type=_positive_int(
+                       "--fleet_scrape_ms (or CST_FLEET_SCRAPE_MS)"),
+                   default=os.environ.get("CST_FLEET_SCRAPE_MS") or 1000,
+                   help="scripts/serve_supervisor.py: fleet-observability "
+                        "scrape cadence (milliseconds) — every interval "
+                        "the supervisor snapshots ALL replica slots (live "
+                        "or not: zero-gap series), appends a schema-"
+                        "stamped line to <--supervise_dir>/"
+                        "fleet_metrics.jsonl, paces per-child "
+                        "{'op': 'stats'} queries and clock-sync pings "
+                        "(OBSERVABILITY.md 'Fleet plane').  Env fallback: "
+                        "CST_FLEET_SCRAPE_MS")
+    g.add_argument("--slo_p99_ms",
+                   type=_nonneg_int("--slo_p99_ms (or CST_SLO_P99_MS)",
+                                    "p99 latency objective disabled"),
+                   default=os.environ.get("CST_SLO_P99_MS") or 0,
+                   help="SLO: target p99 request latency (ms); error "
+                        "budget is the 1%% of requests allowed over it.  "
+                        "Fires a burn-rate slo_alert (fast AND slow "
+                        "window over threshold), flips fleet health to "
+                        "'degraded', gates serve_report/fleet_report "
+                        "exit 1 (OBSERVABILITY.md 'Fleet plane').  0 = "
+                        "disabled.  Env fallback: CST_SLO_P99_MS")
+    g.add_argument("--slo_availability",
+                   type=_ratio("--slo_availability (or "
+                               "CST_SLO_AVAILABILITY)",
+                               "availability objective disabled"),
+                   default=os.environ.get("CST_SLO_AVAILABILITY") or 0.0,
+                   help="SLO: target success fraction in [0, 1), e.g. "
+                        "0.99; the error budget is 1 - target and burn = "
+                        "error_fraction / budget over the sliding "
+                        "windows.  0 = disabled.  Env fallback: "
+                        "CST_SLO_AVAILABILITY")
+    g.add_argument("--slo_error_rate",
+                   type=_ratio("--slo_error_rate (or CST_SLO_ERROR_RATE)",
+                               "error-rate objective disabled"),
+                   default=os.environ.get("CST_SLO_ERROR_RATE") or 0.0,
+                   help="SLO: max tolerated error fraction in [0, 1); "
+                        "burn = error_fraction / target over the sliding "
+                        "windows.  0 = disabled.  Env fallback: "
+                        "CST_SLO_ERROR_RATE")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:

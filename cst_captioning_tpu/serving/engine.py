@@ -630,10 +630,18 @@ class ServingEngine:
             # stream reconciles with the engine's latency bookkeeping;
             # a re-entry after a replica kill/rotation is "requeued",
             # stamped NOW (its arrival is the original submission's).
+            # A supervising front end's cross-process trace context
+            # (meta["trace"], SERVING.md "Wire format") is echoed as
+            # `trace_id` so fleet_trace.py can join this process's
+            # async track to the supervisor's.
+            tr = (meta or {}).get("trace")
+            attrs = ({"trace_id": tr.get("id")}
+                     if isinstance(tr, dict) else {})
             if _requeued:
-                self._lifecycle.emit("requeued", request_id)
+                self._lifecycle.emit("requeued", request_id, **attrs)
             else:
-                self._lifecycle.emit("received", request_id, ts=arrival)
+                self._lifecycle.emit("received", request_id, ts=arrival,
+                                     **attrs)
         # Exact-result cache, IN FRONT of admission (and of the bounded
         # queue: a hit consumes no slot, no queue depth, no decode — it
         # would be self-defeating to shed one).

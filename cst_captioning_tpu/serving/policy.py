@@ -11,7 +11,12 @@ processes over sockets) implement the same serving policies:
   status is its sickest replica's, with per-replica detail alongside;
 - **fleet-edge deadline shed** (:func:`deadline_unmeetable`): a TTL
   provably below EVERY candidate's p99 service floor is shed at the
-  edge with an explicit answer, before it wastes a queue slot anywhere.
+  edge with an explicit answer, before it wastes a queue slot anywhere;
+- **paced child queries** (:class:`QueryPacer`): the ONE interval +
+  failure-backoff policy for everything the supervisor asks a child on
+  a timer (health polls, the fleet-metrics scraper, clock pings) — one
+  policy object per query family, so "how often do we poke a struggling
+  child" cannot fork between the health plane and the metrics plane.
 
 Both routers import these functions rather than re-deriving the policy,
 so a policy change cannot fork the two topologies (SERVING.md "Fleet" /
@@ -59,3 +64,49 @@ def deadline_unmeetable(ttl_ms: float,
     if not floors or any(f is None for f in floors):
         return False
     return float(ttl_ms) / 1e3 < min(floors)
+
+
+class QueryPacer:
+    """Per-key interval pacing with failure backoff — the shared policy
+    behind every timed supervisor→child query (ISSUE 17 satellite: the
+    health poll and the fleet scraper must not each invent their own).
+
+    A key (replica index, or any hashable) is **due** when its interval
+    has elapsed since the last :meth:`sent`; a never-queried key is due
+    immediately (the supervisor's first tick polls everything — the PR 16
+    health-poll semantics, preserved).  Consecutive :meth:`failed` marks
+    double the key's effective interval (capped at ``backoff_cap``
+    multiples) so a wedged child is poked gently; one :meth:`ok` snaps
+    it back.  :meth:`forget` resets a key entirely — call it when a
+    replica restarts, so the fresh process is queried immediately.
+
+    Pure host bookkeeping around a caller-supplied ``now`` (the
+    supervisor's injected clock) — no threads, no time reads of its own,
+    deterministic under a fake clock.
+    """
+
+    def __init__(self, interval_s: float, backoff_cap: int = 8):
+        self.interval_s = max(float(interval_s), 0.0)
+        self.backoff_cap = max(int(backoff_cap), 1)
+        self._last: dict = {}      # key -> last sent `now`
+        self._failures: dict = {}  # key -> consecutive failures
+
+    def due(self, key, now: float) -> bool:
+        last = self._last.get(key)
+        if last is None:
+            return True
+        mult = min(2 ** self._failures.get(key, 0), self.backoff_cap)
+        return (now - last) >= self.interval_s * mult
+
+    def sent(self, key, now: float) -> None:
+        self._last[key] = float(now)
+
+    def ok(self, key) -> None:
+        self._failures.pop(key, None)
+
+    def failed(self, key) -> None:
+        self._failures[key] = self._failures.get(key, 0) + 1
+
+    def forget(self, key) -> None:
+        self._last.pop(key, None)
+        self._failures.pop(key, None)

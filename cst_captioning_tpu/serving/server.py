@@ -5,7 +5,15 @@ Protocol (one JSON object per line, either direction):
   request:   {"id": <any>, "video_id": "<key>"}
              optional: "op": "caption" (default) | "stream" | "health",
                        "deadline_ms": <per-request TTL override>,
-                       "no_cache": true  (skip the exact-result cache)
+                       "no_cache": true  (skip the exact-result cache),
+                       "trace": {"id", "recv_s"}  — cross-process trace
+                       context stamped by a supervising front end
+                       (SERVING.md "Wire format"); echoed into this
+                       process's lifecycle events (`trace_id`) so
+                       scripts/fleet_trace.py can stitch the request's
+                       async track across the process boundary.
+                       Ignored when absent — single-process wire
+                       traffic is unchanged.
   response:  {"id", "video_id", "caption", "latency_ms", "decode_steps"}
              (cache hits add "cached": true; streamed finals add
              "stream": true, "final": true, "chunks": N, "ttft_ms")
@@ -20,6 +28,13 @@ Protocol (one JSON object per line, either direction):
              scheduler statistics view, including the per-request
              latency-attribution report when the lifecycle tracer is
              armed (SERVING.md "Wire format")
+  ping:      {"op": "ping", "seq": k, "t0": <sender monotonic>} ->
+             {"op": "ping", "seq", "t0", "mono": <this process's
+             monotonic>, "wall": <this process's wall clock>, "pid"} —
+             the clock-offset handshake: the supervisor's midpoint
+             estimate (offset = child wall - (send wall + rtt/2),
+             uncertainty <= rtt/2) feeds the skew table trace
+             stitching rebases child events with (ISSUE 17)
   dump:      {"op": "dump"} -> the flight recorder writes blackbox.json
              (atomic) and answers {"op": "dump", "path", "events",
              "emitted"}; "path" in the request overrides the configured
@@ -60,6 +75,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import socket
 import sys
@@ -128,7 +144,8 @@ class CaptionServer:
         self.blackbox_path = blackbox_path
         if registry is not None:
             registry.declare("serve_bad_lines", "serve_health_queries",
-                             "serve_stats_queries", "serve_dump_queries")
+                             "serve_stats_queries", "serve_dump_queries",
+                             "serve_ping_queries")
         self._inbox: "queue.Queue" = queue.Queue()
         self._eof = threading.Event()
         self._write_lock = named_lock("serving.server.write")
@@ -314,6 +331,19 @@ class CaptionServer:
                 self.registry.inc("serve_stats_queries")
             self._write(respond, {"op": "stats", **self.engine.stats()})
             return
+        if op == "ping":
+            # Clock-sync echo (module docstring): answer immediately
+            # with this process's clocks — both reads taken back to
+            # back so the echo's own skew stays inside the sender's
+            # rtt/2 uncertainty bound.
+            if self.registry is not None:
+                self.registry.inc("serve_ping_queries")
+            self._write(respond, {"op": "ping", "seq": req.get("seq"),
+                                  "t0": req.get("t0"),
+                                  "mono": time.monotonic(),
+                                  "wall": time.time(),
+                                  "pid": os.getpid()})
+            return
         if op == "dump":
             # On-demand flight-recorder dump: write blackbox.json NOW
             # (atomic_json_write) and answer with where it landed —
@@ -341,8 +371,8 @@ class CaptionServer:
             self._write(respond, {"id": req.get("id"), "error": "unknown_op",
                                   "op": op,
                                   "detail": "expected op 'caption', "
-                                            "'stream', 'health', 'stats' "
-                                            "or 'dump'"})
+                                            "'stream', 'health', 'stats', "
+                                            "'ping' or 'dump'"})
             return
         stream = (op == "stream")
         if stream and self.engine.chunk >= self.engine.max_len:
@@ -376,11 +406,17 @@ class CaptionServer:
             self._write(respond, {"id": rid, "error": "unknown_video",
                                   "video_id": vid})
             return
+        meta = {"id": rid, "video_id": vid, "respond": respond,
+                "stream": stream}
+        tr = req.get("trace")
+        if isinstance(tr, dict):
+            # Cross-process trace context rides the meta into the
+            # engine's lifecycle emits (module docstring).
+            meta["trace"] = tr
         try:
             ok = self.engine.submit(
                 (rid, vid), [np.asarray(f) for f in feats],
-                meta={"id": rid, "video_id": vid, "respond": respond,
-                      "stream": stream},
+                meta=meta,
                 deadline_ms=deadline_ms, stream=stream,
                 no_cache=bool(req.get("no_cache")))
         except ValueError as e:

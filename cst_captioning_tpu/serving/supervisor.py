@@ -61,6 +61,20 @@ deadline shed) are the EXTRACTED router policies of
 :mod:`serving.policy`, shared with :class:`fleet.FleetRouter` rather
 than re-derived.
 
+**The fleet observability plane rides the tick** (ISSUE 17,
+:mod:`telemetry.fleetobs` / OBSERVABILITY.md "Fleet plane"): when the
+supervisor is armed with a ``fleet_obs`` collaborator it stamps
+per-request trace context onto the child wire (children echo it through
+their lifecycle events so ``scripts/fleet_trace.py`` can stitch one
+Perfetto track across the process boundary), answers timestamped
+``{"op": "ping"}`` echoes into a per-process clock-skew table, scrapes
+every replica slot's stats/health on a cadence into
+``fleet_metrics.jsonl``, and evaluates SLO burn rates whose firing
+alerts degrade the fleet health view.  All child-facing queries —
+health polls included — go through the ONE paced
+:meth:`ProcessFleetSupervisor.query_child` path with
+:class:`serving.policy.QueryPacer` interval/backoff policy.
+
 **Chaos is first-class** (RESILIENCE.md): ``proc_kill@replica=K`` →
 SIGKILL (dump-before-kill), ``proc_wedge@replica=K`` → SIGSTOP until
 the wedge timeout fires the 124 path, ``proc_preempt@replica=K`` →
@@ -102,7 +116,8 @@ from ..resilience.exitcodes import (EXIT_OK, EXIT_PREEMPTED, EXIT_SIGTERM,
                                     EXIT_WEDGE, classify, describe, normalize)
 from ..resilience.integrity import atomic_json_write
 from ..utils.locksan import declare_order, named_lock
-from .policy import deadline_unmeetable, rank_key, worst_status
+from .policy import (QueryPacer, deadline_unmeetable, rank_key,
+                     worst_status)
 
 log = logging.getLogger("cst_captioning_tpu.serving.supervisor")
 
@@ -335,6 +350,8 @@ class ProcReplica:
         self.last_stats: Optional[Dict[str, Any]] = None
         self.last_rc: Optional[int] = None
         self.completed = 0
+        self.kill_at: Optional[float] = None   # pending deliberate-kill
+                                               # deadline (real monotonic)
 
     @property
     def live(self) -> bool:
@@ -357,6 +374,7 @@ class ProcessFleetSupervisor:
                  dump_grace_s: float = 2.0,
                  incident_dir: Optional[str] = None,
                  fault_plan=None, registry=None, lifecycle=None,
+                 fleet_obs=None,
                  clock: Callable[[], float] = time.monotonic,
                  spawn_async: bool = True):
         n = int(replicas)
@@ -373,6 +391,12 @@ class ProcessFleetSupervisor:
         self._plan = fault_plan
         self._registry = registry
         self._lifecycle = lifecycle
+        # Optional fleet observability plane (telemetry/fleetobs.py,
+        # ISSUE 17): trace-context stamping, clock pings, the metrics
+        # scraper and the SLO monitor all hang off this one hook —
+        # None costs one is-None check per call site (the house rule),
+        # and keeps the wire byte-identical for unarmed fleets.
+        self._fleet_obs = fleet_obs
         self.clock = clock
         self.spawn_async = spawn_async
         # Single-owner scheduler state (the module-docstring contract).
@@ -384,7 +408,13 @@ class ProcessFleetSupervisor:
         self._completed = 0
         self._latencies_ms: List[float] = []  # cstlint: owned_by=scheduler
         self._draining = False  # cstlint: owned_by=scheduler
-        self._last_health = float("-inf")
+        # Health polling rides the SHARED child-query pacing policy
+        # (serving/policy.QueryPacer — the ISSUE 17 satellite): the
+        # same interval/backoff object family the fleet scraper uses,
+        # so "how often do we poke a child" cannot fork between the
+        # health plane and the metrics plane.  A never-polled child is
+        # due immediately (first-tick semantics preserved).
+        self._health_pacer = QueryPacer(self.health_interval_s)
         self._dirty = True
         # Restart spawns hatch through a thread-safe queue: the helper
         # thread touches ONLY the launcher and this queue.
@@ -430,6 +460,12 @@ class ProcessFleetSupervisor:
         rep.health = {}
         rep.compiles0 = None
         rep.last_stats = None
+        # A fresh OS process: poll it immediately, and let the fleet
+        # plane drop the dead generation's pacing history and in-flight
+        # clock pings (skew is per process — re-measured per restart).
+        self._health_pacer.forget(rep.index)
+        if self._fleet_obs is not None:
+            self._fleet_obs.on_child_assigned(rep.index)
         self._dirty = True
 
     def _spawn_failed(self, rep: ProcReplica, err: BaseException) -> None:
@@ -554,6 +590,7 @@ class ProcessFleetSupervisor:
                     len(rep.inflight))
         child.close()
         rep.child = None
+        rep.kill_at = None
         self._dirty = True
         expected = self._draining and cls in ("ok", "resumable")
         if not expected:
@@ -629,20 +666,33 @@ class ProcessFleetSupervisor:
     def _dump_then_kill(self, rep: ProcReplica) -> None:
         """The deliberate-kill protocol: ask the child's flight
         recorder to land blackbox.json first (``{"op": "dump"}``),
-        bounded grace, then SIGKILL.  Real wall-clock for the grace —
-        a frozen test clock must not turn this into a spin."""
+        bounded grace, then SIGKILL.  The grace does NOT block the
+        tick loop — a pending deadline is stamped and
+        :meth:`_finish_pending_kills` lands the kill once the blackbox
+        appears or the grace expires, so the health/scrape planes keep
+        running through a deliberate kill (the fleet_report blackout
+        gate caught the blocking version going dark).  Real wall-clock
+        for the grace — a frozen test clock must not turn it into a
+        wait that never expires."""
         try:
             rep.child.send_line(json.dumps({"op": "dump"}))
         except OSError:
             pass
-        bb = (os.path.join(rep.workdir, "blackbox.json")
-              if rep.workdir else None)
-        t0 = time.monotonic()
-        while bb and time.monotonic() - t0 < self.dump_grace_s:
-            if os.path.exists(bb):
-                break
-            time.sleep(0.02)
-        rep.child.kill()
+        rep.kill_at = time.monotonic() + self.dump_grace_s
+
+    def _finish_pending_kills(self) -> None:
+        for rep in self._replicas:
+            if rep.kill_at is None:
+                continue
+            if rep.child is None:
+                rep.kill_at = None
+                continue
+            bb = (os.path.join(rep.workdir, "blackbox.json")
+                  if rep.workdir else None)
+            if (bb and os.path.exists(bb)) \
+                    or time.monotonic() >= rep.kill_at:
+                rep.kill_at = None
+                rep.child.kill()    # reaped as 137 next tick
 
     # -- chaos -------------------------------------------------------------
 
@@ -688,29 +738,35 @@ class ProcessFleetSupervisor:
 
     # -- health plane ------------------------------------------------------
 
-    def _health_poll(self, now: float) -> None:
-        if now - self._last_health < self.health_interval_s:
-            return
-        self._last_health = now
-        for rep in self._replicas:
-            if not rep.live:
-                continue
-            try:
-                rep.child.send_line('{"op": "health"}')
-            except OSError:
-                pass  # next reap classifies the exit
-
-    def request_stats(self, index: int) -> bool:
-        """Ask replica ``index`` for ``{"op": "stats"}``; the reply
-        lands in its ``last_stats`` on a later tick (probe use)."""
+    def query_child(self, index: int, payload: Dict[str, Any]) -> bool:
+        """The ONE child-query send path every timed poller routes
+        through (health poll, fleet scraper, clock pings — the ISSUE 17
+        share-one-path satellite): serialize, send, report success.  A
+        dead socket answers False — the caller's pacer backs off and
+        the next reap classifies the exit."""
         rep = self._replicas[int(index)]
         if not rep.live:
             return False
         try:
-            rep.child.send_line('{"op": "stats"}')
+            rep.child.send_line(json.dumps(payload))
         except OSError:
             return False
         return True
+
+    def _health_poll(self, now: float) -> None:
+        for rep in self._replicas:
+            if not rep.live:
+                continue
+            if not self._health_pacer.due(rep.index, now):
+                continue
+            self._health_pacer.sent(rep.index, now)
+            if not self.query_child(rep.index, {"op": "health"}):
+                self._health_pacer.failed(rep.index)
+
+    def request_stats(self, index: int) -> bool:
+        """Ask replica ``index`` for ``{"op": "stats"}``; the reply
+        lands in its ``last_stats`` on a later tick (probe use)."""
+        return self.query_child(index, {"op": "stats"})
 
     def dump_children(self) -> int:
         """Forward ``{"op": "dump"}`` to every live child (the fleet
@@ -726,6 +782,47 @@ class ProcessFleetSupervisor:
             except OSError:
                 pass
         return n
+
+    def scrape_snapshot(self) -> Dict[str, Any]:
+        """The fleet scraper's per-tick view (telemetry/fleetobs.py):
+        one entry per replica SLOT regardless of state — live,
+        restarting or dead — so the scraped series has zero per-replica
+        gaps across a child restart; the latest health/stats replies
+        ride along.  Scheduler thread only."""
+        with self._requeue_lock:
+            parked = len(self._parked)
+        lat = sorted(self._latencies_ms)
+
+        def pct(q: float) -> Optional[float]:
+            if not lat:
+                return None
+            ix = min(len(lat) - 1, int(round(q / 100 * (len(lat) - 1))))
+            return round(lat[ix], 3)
+
+        children = []
+        for rep in self._replicas:
+            children.append({
+                "index": rep.index, "state": rep.state, "live": rep.live,
+                "restarts": rep.restarts,
+                "inflight": len(rep.inflight),
+                "pid": (rep.child.pid if rep.child is not None else None),
+                "health": dict(rep.health),
+                "stats": (dict(rep.last_stats)
+                          if rep.last_stats is not None else None),
+            })
+        return {
+            "fleet": {
+                "replicas": len(self._replicas),
+                "in_service": sum(1 for r in self._replicas if r.live),
+                "outstanding": len(self._pending),
+                "parked": parked,
+                "completed": self._completed,
+                "latency_p50_ms": pct(50),
+                "latency_p99_ms": pct(99),
+                "supervisor": self.supervisor_counters(),
+            },
+            "children": children,
+        }
 
     def _update_snapshots(self) -> None:
         snaps: List[Dict[str, Any]] = []
@@ -772,8 +869,18 @@ class ProcessFleetSupervisor:
             totals = dict(self._totals)
             with self._requeue_lock:
                 parked = len(self._parked)
+        status = worst_status(s["status"] for s in per)
+        out: Dict[str, Any] = {}
+        if self._fleet_obs is not None:
+            if self._fleet_obs.alerting:
+                # A fast-burning SLO is a fleet-health fact: the
+                # worst-of view degrades while the alert is firing
+                # (ISSUE 17), even when every replica reports ok.
+                status = worst_status((status, "degraded"))
+            out["slo"] = self._fleet_obs.slo_status()
         return {
-            "status": worst_status(s["status"] for s in per),
+            **out,
+            "status": status,
             "replicas": len(per),
             "in_service": sum(1 for s in per
                               if s["status"] in ("ok", "degraded")),
@@ -801,7 +908,7 @@ class ProcessFleetSupervisor:
             ix = min(len(lat) - 1, int(round(q / 100 * (len(lat) - 1))))
             return round(lat[ix], 3)
 
-        return {
+        out = {
             "replicas": len(self._replicas),
             "in_service": sum(1 for r in self._replicas if r.live),
             "outstanding": len(self._pending),
@@ -813,6 +920,9 @@ class ProcessFleetSupervisor:
             "per_replica": per,
             "incidents": [dict(i) for i in self._incidents],
         }
+        if self._fleet_obs is not None:
+            out["slo"] = self._fleet_obs.slo_status()
+        return out
 
     # -- routing -----------------------------------------------------------
 
@@ -845,7 +955,8 @@ class ProcessFleetSupervisor:
         first (the child's own health status), the supervisor's
         in-flight count as the load, index tiebreak."""
         active = [r for r in self._replicas
-                  if r.live and r.index not in tried]
+                  if r.live and r.kill_at is None
+                  and r.index not in tried]
         return sorted(active, key=lambda r: rank_key(
             r.health.get("status") == "degraded",
             len(r.inflight), r.index))
@@ -859,6 +970,7 @@ class ProcessFleetSupervisor:
         cands = self._candidates(pr.tried)
         if not cands:
             if any(r.state in ("starting", "backoff")
+                   or r.kill_at is not None
                    for r in self._replicas):
                 # Momentarily no live child (restarts in flight): HOLD
                 # — the request outlives the replica that owned it.
@@ -882,6 +994,14 @@ class ProcessFleetSupervisor:
             msg["deadline_ms"] = rem
         if pr.no_cache:
             msg["no_cache"] = True
+        if self._fleet_obs is not None:
+            # Cross-process trace context (SERVING.md wire addendum):
+            # the child threads this through its lifecycle events, so
+            # fleet_trace.py can join its async track to the
+            # supervisor's.  `recv_s` is the supervisor's intake clock
+            # (its own monotonic domain — context, not a timestamp the
+            # child may compare against its clocks).
+            msg["trace"] = {"id": pr.sup_id, "recv_s": pr.arrival}
         line = json.dumps(msg)
         for i, rep in enumerate(cands):
             try:
@@ -953,6 +1073,7 @@ class ProcessFleetSupervisor:
             op = obj.get("op")
             if op == "health":
                 rep.health = obj
+                self._health_pacer.ok(rep.index)
                 if rep.compiles0 is None and "compiles" in obj:
                     # First health after (re)start: the post-warm
                     # compile baseline the probe's zero-recompile
@@ -962,6 +1083,15 @@ class ProcessFleetSupervisor:
                 continue
             if op == "stats":
                 rep.last_stats = obj
+                if self._fleet_obs is not None:
+                    self._fleet_obs.on_stats(rep.index)
+                continue
+            if op == "ping":
+                # Clock-sync echo (ISSUE 17): only the fleet plane
+                # sends pings, so an unarmed supervisor never sees one.
+                if self._fleet_obs is not None:
+                    self._fleet_obs.on_ping(rep.index, obj,
+                                            t1=self.clock())
                 continue
             if op == "dump":
                 continue   # the child announced where its blackbox went
@@ -1050,6 +1180,10 @@ class ProcessFleetSupervisor:
         if pr.stream and out.get("final") and "chunks" in out:
             out["chunks"] = pr.seq_out   # chunks the CLIENT saw
         err = out.get("error")
+        if self._fleet_obs is not None:
+            self._fleet_obs.observe_request(
+                err is None and "caption" in out,
+                out.get("latency_ms"), self.clock())
         if err is None and "caption" in out:
             rep.completed += 1
             rep.backoff_level = 0   # healthy again: backoff resets
@@ -1070,6 +1204,10 @@ class ProcessFleetSupervisor:
 
     def _finish(self, pr: ProxyRequest, obj: Dict[str, Any],
                 kind: str, **attrs) -> None:
+        if self._fleet_obs is not None:
+            # Every supervisor-written terminal (shed/expired/drain
+            # reject) is a failed outcome in the SLO books.
+            self._fleet_obs.observe_request(False, None, self.clock())
         self._pending.pop(pr.sup_id, None)
         if pr.replica is not None:
             self._replicas[pr.replica].inflight.discard(pr.sup_id)
@@ -1118,8 +1256,11 @@ class ProcessFleetSupervisor:
         self._restart_due(now)
         moved = self._pump_children()
         self._fire_proc_faults()
+        self._finish_pending_kills()
         self._check_wedges(now)
         self._health_poll(now)
+        if self._fleet_obs is not None:
+            self._fleet_obs.tick(self, now)
         self._retry_parked(now)
         if self._dirty:
             self._dirty = False

@@ -78,6 +78,11 @@ EVENT_KINDS = (
     "shed",          # terminal: backpressure shed (queue or fleet edge)
     "completed",     # terminal: caption harvested (attrs: latency_ms)
     "responded",     # the front end wrote the final wire response
+    "slo_alert",     # fleet SLO burn-rate alert fired/cleared (attrs:
+                     # objective, state, fast_burn, slow_burn) — id is
+                     # the objective name, not a request; its chain has
+                     # no `received` so accounting counts it truncated,
+                     # never a terminal violation (telemetry/fleetobs.py)
 )
 
 #: The kinds that END a request's story exactly once.  ``responded`` is

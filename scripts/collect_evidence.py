@@ -94,6 +94,21 @@ def main() -> int:
                        "heartbeat.json", "telemetry.json", "stderr.log"):
                 take(os.path.join("incidents", incident, fn))
 
+    # Fleet-observability evidence (OBSERVABILITY.md "Fleet plane"):
+    # the scraped metrics series (active file + every rotated part +
+    # the part index), the SLO alert transition log, the clock-offset
+    # table that stitched the traces, and the merged fleet trace
+    # itself — together they back any latency/SLO claim made about a
+    # supervised run.
+    take("fleet_metrics.jsonl")
+    take("fleet_metrics_index.json")
+    for fn in sorted(os.listdir(src)) if os.path.isdir(src) else []:
+        if fn.startswith("fleet_metrics_part") and fn.endswith(".jsonl"):
+            take(fn)
+    take("slo_alerts.jsonl")
+    take("clock_sync.json")
+    take("fleet_trace.json")
+
     # Regenerate the report against the live out_dir so report + copies
     # agree, then keep both renderings.  A wedged/killed chain_report must
     # degrade to "bundle without report" — the MANIFEST below still gets

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Render a supervised fleet's scraped metrics series + SLO verdict.
+
+Reads the append-only ``fleet_metrics.jsonl`` the fleet-observability
+plane writes (telemetry/fleetobs.py: one schema-stamped sample per
+``--fleet_scrape_ms``, one row per replica SLOT per sample — the
+zero-gap contract) and prints the fleet picture over time: fleet-wide
+and per-child p50/p99 latency, queue depth, slot occupancy, cache hit
+rate, restart counts, and the SLO burn-rate status.
+
+  python scripts/fleet_report.py --dir  <supervise_dir>
+  python scripts/fleet_report.py --file <fleet_metrics.jsonl>
+
+Gates (the serve_report discipline — a report that only prints would
+hide a broken plane; each failure is one ``!!`` stderr line + exit 1):
+
+- **no samples** — the scraper never ran or the file is unreadable;
+- **burn-rate violation** — any sample's SLO status shows a firing
+  objective (the supervisor's fast+slow windows both burned over
+  threshold);
+- **scrape blackout** — the wall-clock gap between consecutive samples
+  exceeds ``--blackout_factor`` (default 3) times the stamped scrape
+  interval: the plane went dark while the fleet kept running;
+- **coverage hole** — a sample is missing replica-slot rows (fewer
+  child rows than the fleet's replica count).
+
+See OBSERVABILITY.md "Fleet plane".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_samples(args) -> list:
+    """All parseable fleet_sample rows, parts first then the active
+    file (rotation order); a torn final line (crash mid-append) is
+    skipped, not fatal."""
+    if args.file:
+        paths = [args.file]
+    else:
+        root = os.path.abspath(args.dir)
+        paths = []
+        index_path = os.path.join(root, "fleet_metrics_index.json")
+        if os.path.exists(index_path):
+            try:
+                with open(index_path, "r", encoding="utf-8") as f:
+                    for part in json.load(f).get("parts", []):
+                        paths.append(os.path.join(root, part))
+            except (OSError, ValueError) as e:
+                print(f"fleet_report: part index unreadable: {e}",
+                      file=sys.stderr)
+        paths.append(os.path.join(root, "fleet_metrics.jsonl"))
+    samples = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail of a crashed part
+                    if isinstance(row, dict) \
+                            and row.get("kind") == "fleet_sample":
+                        samples.append(row)
+        except OSError:
+            continue
+    return samples
+
+
+def fmt(v, unit="") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.2f}{unit}"
+    return f"{v}{unit}"
+
+
+def _per_child(samples: list) -> dict:
+    """index -> {rows, live, restarts, last} accumulated over the run."""
+    acc: dict = {}
+    for s in samples:
+        for c in s.get("children", []):
+            idx = c.get("index")
+            a = acc.setdefault(idx, {"rows": 0, "live": 0,
+                                     "restarts": 0, "last": None})
+            a["rows"] += 1
+            if c.get("live"):
+                a["live"] += 1
+            a["restarts"] = max(a["restarts"], int(c.get("restarts") or 0))
+            a["last"] = c
+    return acc
+
+
+def check_gates(samples: list, blackout_factor: float) -> list:
+    """-> list of '!!' gate messages (empty = healthy)."""
+    gates = []
+    firing = sorted({name for s in samples
+                     for name in (s.get("slo") or {}).get("firing", [])})
+    if firing:
+        gates.append(
+            f"SLO burn-rate violation: objective(s) {','.join(firing)} "
+            "fired during the run — fast AND slow windows burned the "
+            "error budget over threshold (OBSERVABILITY.md 'Fleet "
+            "plane')")
+    worst_gap = None
+    for prev, cur in zip(samples, samples[1:]):
+        interval_ms = float(cur.get("interval_ms") or 0)
+        if interval_ms <= 0:
+            continue
+        gap_ms = (float(cur.get("wall", 0)) - float(prev.get("wall", 0))) \
+            * 1e3
+        if gap_ms > blackout_factor * interval_ms and \
+                (worst_gap is None or gap_ms > worst_gap):
+            worst_gap = gap_ms
+    if worst_gap is not None:
+        gates.append(
+            f"scrape blackout: a {worst_gap:,.0f} ms gap between "
+            f"consecutive samples (> {blackout_factor:g}x the scrape "
+            "interval) — the plane went dark while the fleet ran")
+    for s in samples:
+        replicas = (s.get("fleet") or {}).get("replicas")
+        if replicas and len(s.get("children", [])) < int(replicas):
+            gates.append(
+                f"coverage hole at sample seq {s.get('seq')}: "
+                f"{len(s.get('children', []))} child row(s) for "
+                f"{replicas} replica slot(s) — the zero-gap contract "
+                "(one row per slot per sample) is broken")
+            break
+    return gates
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--dir", default=None,
+                     help="the run's --supervise_dir (reads "
+                          "fleet_metrics.jsonl + rotated parts + "
+                          "slo_alerts.jsonl)")
+    src.add_argument("--file", default=None,
+                     help="one fleet_metrics.jsonl to read directly")
+    p.add_argument("--blackout_factor", type=float, default=3.0,
+                   help="scrape-gap gate threshold, in multiples of the "
+                        "stamped scrape interval (default 3)")
+    p.add_argument("--json", default=None,
+                   help="also write the summary as JSON here (atomic)")
+    args = p.parse_args(argv)
+
+    samples = load_samples(args)
+    if not samples:
+        print("fleet_report: no fleet_sample rows found — the scraper "
+              "never wrote (or the path is wrong)", file=sys.stderr)
+        return 1
+    first, last = samples[0], samples[-1]
+    fleet = last.get("fleet") or {}
+    slo = last.get("slo") or {}
+    span_s = float(last.get("wall", 0)) - float(first.get("wall", 0))
+    rows = [
+        ("samples", f"{len(samples)} over {fmt(span_s, ' s')} "
+                    f"(interval {fmt(last.get('interval_ms'), ' ms')})"),
+        ("fleet", f"{fmt(fleet.get('in_service'))}/"
+                  f"{fmt(fleet.get('replicas'))} in service, "
+                  f"{fmt(fleet.get('outstanding'))} outstanding, "
+                  f"{fmt(fleet.get('parked'))} parked, "
+                  f"{fmt(fleet.get('completed'))} completed"),
+        ("fleet latency p50 / p99",
+         f"{fmt(fleet.get('latency_p50_ms'), ' ms')} / "
+         f"{fmt(fleet.get('latency_p99_ms'), ' ms')}"),
+    ]
+    if slo.get("enabled"):
+        for name, obj in (slo.get("objectives") or {}).items():
+            rows.append(
+                (f"slo {name}",
+                 f"target {obj.get('target')}, burn fast "
+                 f"{fmt(obj.get('fast_burn'))} / slow "
+                 f"{fmt(obj.get('slow_burn'))}"
+                 + (" FIRING" if obj.get("firing") else "")))
+        rows.append(("slo alerts",
+                     f"{fmt(slo.get('alerts_fired'))} fired / "
+                     f"{fmt(slo.get('alerts_cleared'))} cleared"))
+    else:
+        rows.append(("slo", "disabled (no --slo_* objective set)"))
+    for idx, a in sorted(_per_child(samples).items()):
+        c = a["last"] or {}
+        occ = c.get("slot_occupancy")
+        hit = c.get("cache_hit_rate")
+        rows.append(
+            (f"  child {idx}",
+             f"{a['rows']} row(s), live {a['live']}/{a['rows']}, "
+             f"{a['restarts']} restart(s); last: state {c.get('state')}, "
+             f"queue {fmt(c.get('queue_depth'))}, p50/p99 "
+             f"{fmt(c.get('latency_p50_ms'), ' ms')}/"
+             f"{fmt(c.get('latency_p99_ms'), ' ms')}, occupancy "
+             f"{'-' if occ is None else f'{occ * 100:.0f}%'}, cache hit "
+             f"{'-' if hit is None else f'{hit * 100:.0f}%'}"))
+    if args.dir:
+        alerts_path = os.path.join(args.dir, "slo_alerts.jsonl")
+        if os.path.exists(alerts_path):
+            try:
+                with open(alerts_path, "r", encoding="utf-8") as f:
+                    n_alerts = sum(1 for line in f if line.strip())
+                rows.append(("alert log", f"{n_alerts} transition(s) in "
+                                          f"{alerts_path}"))
+            except OSError:
+                pass
+    width = max(len(k) for k, _ in rows)
+    print("fleet metrics")
+    for k, v in rows:
+        print(f"  {k:<{width}}  {v}")
+
+    gates = check_gates(samples, args.blackout_factor)
+    for msg in gates:
+        print(f"  !! {msg}", file=sys.stderr)
+    if args.json:
+        from cst_captioning_tpu.resilience.integrity import atomic_json_write
+
+        atomic_json_write(args.json, {
+            "samples": len(samples), "span_s": span_s,
+            "fleet": fleet, "slo": slo, "gates": gates}, indent=2)
+    return 1 if gates else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

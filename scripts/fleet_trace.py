@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Stitch a supervised fleet's traces into ONE Perfetto file.
+
+A ``scripts/serve_supervisor.py`` run leaves per-process Chrome traces:
+the supervisor's own span/lifecycle trace under ``<root>/trace/`` and
+one per child life under ``<root>/replica<K>/trace/`` (each file is
+self-described: ``otherData`` carries the writing pid and the wall-clock
+anchor of its ``ts=0``).  Those timelines do not share a clock — each
+process's ``ts`` is µs since ITS tracer started — so this tool:
+
+1. loads ``<root>/clock_sync.json`` (telemetry/fleetobs.py ClockSync:
+   midpoint offset per child *pid*, uncertainty bounded by rtt/2);
+2. rebases every event onto the supervisor's wall timeline:
+   ``ts_unified = ts + (wall_epoch - skew_s - base_wall) * 1e6`` where
+   ``skew_s`` is the child pid's clock offset (0 for the supervisor)
+   and ``base_wall`` is the earliest corrected anchor, so the merged
+   trace starts at 0;
+3. rewrites child async-track ids to the supervisor's request id: any
+   child async event carrying ``args.trace_id`` (the stamp the
+   supervisor put on the wire and the child's lifecycle echoed) seeds a
+   ``(pid, local_id) -> str(trace_id)`` mapping, so each request renders
+   as ONE async track crossing the process boundary — routed at the
+   supervisor, queued/admitted/decode_chunk in the child, responded
+   back at the supervisor;
+4. labels process rows (``supervisor (pid N)`` / ``replica<K> (pid
+   N)``) and drops a ``clock_skew`` annotation instant per child pid
+   carrying the applied offset and its uncertainty.
+
+Output is a single atomic ``fleet_trace.json`` with
+``otherData.merged = true`` — load it in Perfetto, or render it with
+``scripts/trace_report.py`` (which pairs merged async tracks across
+pids).  See OBSERVABILITY.md "Fleet plane".
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MERGED_SCHEMA = 1
+
+_REPLICA_DIR = re.compile(r"^replica(\d+)$")
+
+
+def _load_docs(trace_dir: str):
+    """-> [(path, doc)] for every loadable Chrome-trace JSON in a dir."""
+    docs = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"fleet_trace: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if isinstance(doc.get("traceEvents"), list):
+            docs.append((path, doc))
+    return docs
+
+
+def _child_trace_dirs(root: str):
+    """-> [(replica_index, trace_dir)] for <root>/replica<K>/trace."""
+    out = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for name in names:
+        m = _REPLICA_DIR.match(name)
+        if not m:
+            continue
+        d = os.path.join(root, name, "trace")
+        if os.path.isdir(d):
+            out.append((int(m.group(1)), d))
+    return out
+
+
+def merge_fleet_trace(root: str, out_path: str = None) -> dict:
+    """Merge one supervised run's traces; returns a summary dict.
+
+    Raises ``FileNotFoundError`` when the supervisor trace dir has no
+    loadable files (nothing to anchor the merged timeline on).
+    """
+    root = os.path.abspath(root)
+    out_path = out_path or os.path.join(root, "fleet_trace.json")
+    sup_docs = _load_docs(os.path.join(root, "trace"))
+    if not sup_docs:
+        raise FileNotFoundError(
+            f"no supervisor trace files under {os.path.join(root, 'trace')}")
+
+    sync_children: dict = {}
+    sync_path = os.path.join(root, "clock_sync.json")
+    if os.path.exists(sync_path):
+        try:
+            with open(sync_path, "r", encoding="utf-8") as f:
+                sync_children = json.load(f).get("children", {}) or {}
+        except (OSError, ValueError) as e:
+            print(f"fleet_trace: clock_sync.json unreadable: {e}",
+                  file=sys.stderr)
+
+    # One entry per source file: (role, replica_index, pid,
+    # corrected_wall_epoch, skew_record_or_None, doc).
+    entries = []
+    missing_sync = set()
+    for path, doc in sup_docs:
+        other = doc.get("otherData") or {}
+        entries.append(("supervisor", None, other.get("pid"),
+                        float(other.get("wall_epoch_unix_s", 0.0)),
+                        None, doc))
+    for index, trace_dir in _child_trace_dirs(root):
+        for path, doc in _load_docs(trace_dir):
+            other = doc.get("otherData") or {}
+            pid = other.get("pid")
+            epoch = float(other.get("wall_epoch_unix_s", 0.0))
+            rec = sync_children.get(str(pid))
+            if rec is None:
+                missing_sync.add(pid)
+            skew = float(rec["skew_s"]) if rec else 0.0
+            entries.append(("replica", index, pid, epoch - skew, rec, doc))
+
+    base_wall = min(e[3] for e in entries)
+
+    # Pass 1: the stitch table — any child async event that echoes the
+    # supervisor's trace stamp maps its local track id onto the
+    # supervisor's request id.
+    id_map: dict = {}
+    for role, index, pid, _epoch, _rec, doc in entries:
+        if role != "replica":
+            continue
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") not in ("b", "n", "e"):
+                continue
+            args = ev.get("args")
+            if isinstance(args, dict) and args.get("trace_id") is not None:
+                id_map[(pid, ev.get("id"))] = str(args["trace_id"])
+
+    merged = []
+    skew_annotated = set()
+    for role, index, pid, epoch, rec, doc in entries:
+        shift_us = (epoch - base_wall) * 1e6
+        label = (f"supervisor (pid {pid})" if role == "supervisor"
+                 else f"replica{index} (pid {pid})")
+        if role == "replica" and pid not in skew_annotated:
+            skew_annotated.add(pid)
+            merged.append({
+                "name": "clock_skew", "ph": "i", "s": "p", "cat": "fleet",
+                "ts": shift_us, "pid": pid, "tid": 0,
+                "args": {
+                    "replica": index, "pid": pid,
+                    "skew_ms": (round(rec["skew_s"] * 1e3, 3)
+                                if rec else None),
+                    "uncertainty_ms": (round(rec["uncertainty_s"] * 1e3, 3)
+                                       if rec else None),
+                    "synced": rec is not None,
+                },
+            })
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    ev["args"] = {"name": label}
+                merged.append(ev)
+                continue
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift_us
+            if role == "replica" and ev.get("ph") in ("b", "n", "e"):
+                new_id = id_map.get((pid, ev.get("id")))
+                if new_id is not None:
+                    ev["id"] = new_id
+            merged.append(ev)
+
+    merged.sort(key=lambda ev: ev.get("ts", 0.0))
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged": True,
+            "schema": MERGED_SCHEMA,
+            "base_wall_epoch_unix_s": base_wall,
+            "children": sync_children,
+        },
+    }
+    from cst_captioning_tpu.resilience.integrity import atomic_json_write
+
+    out_parent = os.path.dirname(os.path.abspath(out_path))
+    if out_parent:
+        os.makedirs(out_parent, exist_ok=True)
+    atomic_json_write(out_path, doc)
+    return {
+        "out": out_path,
+        "events": len(merged),
+        "sources": len(entries),
+        "child_pids": len({e[2] for e in entries if e[0] == "replica"}),
+        "stitched_tracks": len(set(id_map.values())),
+        "missing_sync_pids": sorted(p for p in missing_sync
+                                    if p is not None),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge a supervised fleet's per-process traces into "
+                    "one clock-skew-corrected Perfetto file")
+    ap.add_argument("--dir", required=True,
+                    help="the run's --supervise_dir root (expects "
+                         "trace/, replica<K>/trace/, clock_sync.json)")
+    ap.add_argument("--out", default=None,
+                    help="merged trace path (default <dir>/"
+                         "fleet_trace.json)")
+    args = ap.parse_args(argv)
+    try:
+        summary = merge_fleet_trace(args.dir, args.out)
+    except FileNotFoundError as e:
+        print(f"fleet_trace: {e}", file=sys.stderr)
+        return 1
+    print("fleet_trace: " + json.dumps(summary))
+    if summary["missing_sync_pids"]:
+        print("fleet_trace: WARNING: no clock-sync sample for pids "
+              f"{summary['missing_sync_pids']} (merged with zero skew)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

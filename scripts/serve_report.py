@@ -170,6 +170,14 @@ def main(argv=None) -> int:
                  f"{fmt(pr.get('restarts'))} restart(s) / "
                  f"{fmt(pr.get('kills'))} kill(s), "
                  f"last_rc {fmt(pr.get('last_rc'))}"))
+    slo = rec.get("slo") or {}
+    if slo.get("enabled"):
+        firing = slo.get("firing") or []
+        rows.append(
+            ("slo", f"ok={slo.get('ok')} — firing "
+                    f"{','.join(firing) if firing else 'none'}, "
+                    f"{fmt(slo.get('alerts_fired'))} fired / "
+                    f"{fmt(slo.get('alerts_cleared'))} cleared"))
     attribution = rec.get("attribution") or {}
     lifecycle = rec.get("lifecycle") or {}
     if attribution:
@@ -279,6 +287,12 @@ def main(argv=None) -> int:
               "recorder's stream is lying or a request was silently "
               "lost (OBSERVABILITY.md 'Request lifecycle')",
               file=sys.stderr)
+        rc = 1
+    if slo.get("enabled") and slo.get("ok") is False:
+        print("  !! an SLO burn-rate alert was still firing at probe "
+              "end: the fleet burned its error budget faster than the "
+              "alert threshold in both windows (OBSERVABILITY.md "
+              "'Fleet plane')", file=sys.stderr)
         rc = 1
     if attribution and attribution.get("reconcile_ok") is False:
         print("  !! latency attribution does not reconcile: component "

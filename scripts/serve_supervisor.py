@@ -73,6 +73,11 @@ def child_argv(opt, workdir: str, replica: int, plan=None) -> list:
             os.path.join(workdir, "heartbeat.json"),
             "--serve_telemetry_file",
             os.path.join(workdir, "telemetry.json"),
+            # Per-child span traces (ISSUE 17): each child writes its
+            # own Chrome-trace files here; scripts/fleet_trace.py
+            # rebases them onto the supervisor's timeline (via
+            # clock_sync.json) and merges ONE Perfetto file.
+            "--trace_dir", os.path.join(workdir, "trace"),
             "--loglevel", "WARNING"]
     forward = [("--serve_demo", opt.serve_demo),
                ("--serve_demo_eos_bias", opt.serve_demo_eos_bias),
@@ -126,7 +131,7 @@ def make_launcher(opt, root: str, plan=None):
 
 
 def build_supervisor(opt, root: str, *, plan=None, registry=None,
-                     lifecycle=None):
+                     lifecycle=None, fleet_obs=None):
     from cst_captioning_tpu.serving.supervisor import ProcessFleetSupervisor
 
     return ProcessFleetSupervisor(
@@ -135,7 +140,56 @@ def build_supervisor(opt, root: str, *, plan=None, registry=None,
         backoff_ms=opt.supervise_backoff_ms,
         wedge_timeout_s=opt.wedge_timeout,
         incident_dir=os.path.join(root, "incidents"),
-        fault_plan=plan, registry=registry, lifecycle=lifecycle)
+        fault_plan=plan, registry=registry, lifecycle=lifecycle,
+        fleet_obs=fleet_obs)
+
+
+def build_observability(opt, root: str, registry):
+    """Arm the supervisor's own telemetry plane (ISSUE 17): span tracer
+    (``<root>/trace/``, the supervisor row of the merged fleet trace),
+    lifecycle flight recorder, and the FleetObs scraper + clock sync +
+    SLO monitor (always on in supervisor runs; cadence from
+    ``--fleet_scrape_ms``, objectives from ``--slo_*`` — each 0 simply
+    disables that objective, never the scrape).
+
+    Returns ``(tracer, lifecycle, fleet_obs)`` — tracer/lifecycle are
+    None when ``--serve_lifecycle 0`` / tracing is declined, fleet_obs
+    is always real."""
+    from cst_captioning_tpu.telemetry.fleetobs import FleetObs, SLOMonitor
+
+    tracer = None
+    lifecycle = None
+    if opt.serve_lifecycle:
+        from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+        from cst_captioning_tpu.telemetry.spans import SpanTracer
+
+        tracer = SpanTracer(os.path.join(root, "trace"))
+        lifecycle = LifecycleTracer(opt.serve_lifecycle_events,
+                                    tracer=tracer, registry=registry)
+    slo = SLOMonitor(p99_ms=opt.slo_p99_ms,
+                     availability=opt.slo_availability,
+                     error_rate=opt.slo_error_rate,
+                     lifecycle=lifecycle, registry=registry)
+    fleet_obs = FleetObs(root,
+                         scrape_interval_s=opt.fleet_scrape_ms / 1000.0,
+                         slo=slo, registry=registry, lifecycle=lifecycle)
+    return tracer, lifecycle, fleet_obs
+
+
+def close_observability(tracer, fleet_obs) -> None:
+    """Flush the plane's durable artifacts (final fsync + clock_sync
+    + the tracer's trace_<pid>.json) — safe to call on any exit path."""
+    try:
+        fleet_obs.close()
+    except OSError as e:
+        print(f"serve_supervisor: fleet_obs close failed: {e}",
+              file=sys.stderr)
+    if tracer is not None:
+        try:
+            tracer.close()
+        except OSError as e:
+            print(f"serve_supervisor: tracer close failed: {e}",
+                  file=sys.stderr)
 
 
 def write_supervisor_exit(root: str, rc: int, sup, registry) -> None:
@@ -222,7 +276,12 @@ def run_probe(opt) -> int:
     video_ids = [f"v{i % 16}" for i in range(num_requests)]
     answers: dict = {i: [] for i in range(num_requests)}
 
-    sup = build_supervisor(opt, root, plan=plan, registry=registry)
+    tracer, lifecycle, fleet_obs = build_observability(opt, root, registry)
+    if lifecycle is not None:
+        lifecycle.attach(
+            counters=lambda: registry.snapshot().get("counters"))
+    sup = build_supervisor(opt, root, plan=plan, registry=registry,
+                           lifecycle=lifecycle, fleet_obs=fleet_obs)
     rc = 0
     try:
         # Capture every child's post-warm compile baseline BEFORE
@@ -321,6 +380,14 @@ def run_probe(opt) -> int:
         budget_ok = c["sup_replica_deaths"] == 0
         lat = [stats.get("latency_p50_ms"), stats.get("latency_p99_ms")]
 
+        # ISSUE 17 evidence: the SLO verdict and the fleet-plane
+        # artifact paths ride the record so serve_report can gate on a
+        # burn-rate violation and collect_evidence can bundle the
+        # series + clock table + traces.
+        slo_status = fleet_obs.slo_status()
+        slo_ok = not slo_status.get("firing")
+        sync_children = fleet_obs.clock_sync.doc()["children"]
+
         record = {
             "metric": SERVE_METRIC, "schema": 1,
             "value": round(completed / makespan, 2) if makespan else None,
@@ -335,6 +402,17 @@ def run_probe(opt) -> int:
             "recompiles_after_warmup": recompiles,
             "stream": {"enabled": True, "prefix_ok": prefix_ok,
                        "chunks": chunks_total},
+            "slo": {"enabled": slo_status.get("enabled", False),
+                    "firing": slo_status.get("firing", []),
+                    "alerts_fired": slo_status.get("alerts_fired", 0),
+                    "alerts_cleared": slo_status.get("alerts_cleared", 0),
+                    "ok": slo_ok},
+            "fleet_obs": {
+                "samples": len(fleet_obs.series()),
+                "metrics_file": fleet_obs.metrics_path,
+                "clock_synced_pids": len(sync_children),
+                "trace_dir": os.path.join(root, "trace"),
+            },
             "supervisor": {
                 "enabled": True,
                 "replicas": opt.supervise_replicas,
@@ -358,11 +436,12 @@ def run_probe(opt) -> int:
             "parity_ok": parity_ok, "prefix_ok": prefix_ok,
             "recompiles": recompiles, "budget_ok": budget_ok,
             "blackbox_harvested": blackbox_harvested,
+            "slo_ok": slo_ok,
         }
         print(f"serve_supervisor: probe {json.dumps(report)}",
               file=sys.stderr)
         if not all([report["answered"], parity_ok, prefix_ok,
-                    recompiles == 0, blackbox_harvested]):
+                    recompiles == 0, blackbox_harvested, slo_ok]):
             rc = 1
     except SupervisorUnrecoverable as e:
         from cst_captioning_tpu.resilience.exitcodes import (EXIT_WEDGE,
@@ -373,6 +452,7 @@ def run_probe(opt) -> int:
         rc = EXIT_WEDGE
     finally:
         sup.shutdown()
+        close_observability(tracer, fleet_obs)
         write_supervisor_exit(root, rc, sup, registry)
         print("serve_supervisor: " + json.dumps(sup.supervisor_counters()),
               file=sys.stderr)
@@ -401,18 +481,15 @@ def run_serving(opt) -> int:
     root = opt.supervise_dir or tempfile.mkdtemp(prefix="cst_supervise_")
     os.makedirs(root, exist_ok=True)
 
-    # The supervisor's OWN flight recorder: intake/route/requeue/
-    # terminal events per request, dumped by the {"op": "dump"} wire op
-    # and the hard-abort/124 paths — the children each run their own.
-    lifecycle = None
-    if opt.serve_lifecycle:
-        from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
-
-        lifecycle = LifecycleTracer(opt.serve_lifecycle_events,
-                                    registry=registry)
+    # The supervisor's OWN flight recorder + span tracer + the ISSUE 17
+    # fleet plane: intake/route/requeue/terminal events per request
+    # (dumped by the {"op": "dump"} wire op and the hard-abort/124
+    # paths — the children each run their own), the supervisor row of
+    # the merged fleet trace, the metrics scraper and the SLO monitor.
+    tracer, lifecycle, fleet_obs = build_observability(opt, root, registry)
 
     sup = build_supervisor(opt, root, plan=plan, registry=registry,
-                           lifecycle=lifecycle)
+                           lifecycle=lifecycle, fleet_obs=fleet_obs)
     blackbox = (os.path.join(root, "blackbox.json")
                 if opt.serve_blackbox else None)
     server = SupervisorServer(sup, handler=handler, registry=registry,
@@ -464,6 +541,7 @@ def run_serving(opt) -> int:
     finally:
         if watchdog is not None:
             watchdog.stop()
+        close_observability(tracer, fleet_obs)
         stats = sup.stats()
         print("serve_supervisor: " + json.dumps(stats), file=sys.stderr)
         if opt.result_file:

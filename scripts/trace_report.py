@@ -15,6 +15,13 @@ where the host wall-time went:
   the terminal view of a request's journey; the same files load
   graphically in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
 
+Merged fleet traces (``scripts/fleet_trace.py`` output, marked
+``otherData.merged``) render too: async tracks are then paired WITHOUT
+the pid — a stitched request's ``b``/``e`` span different processes by
+design — nesting counted, and a per-process row table (supervisor +
+each replica, event counts, clock-skew annotations) is added.
+Single-process records keep the exact legacy rendering.
+
 Usage:
   python scripts/trace_report.py --trace_dir /tmp/run/trace [--json out.json]
 """
@@ -37,8 +44,15 @@ from cst_captioning_tpu.resilience.integrity import (  # noqa: E402
 
 def load_events(trace_dir: str):
     """Every span/instant/async event from every trace_*.json part file
-    -> (complete_spans, instants, async_events, files)."""
+    -> (complete_spans, instants, async_events, files, meta).
+
+    ``meta`` describes the trace's shape: ``merged`` (True when any
+    file is a fleet_trace.py stitch, i.e. ``otherData.merged``) and
+    ``processes`` — pid -> {"name", "events"} from the Chrome
+    ``process_name`` metadata plus per-pid event counts.
+    """
     spans, instants, asyncs = [], [], []
+    meta = {"merged": False, "processes": {}}
     files = sorted(glob.glob(os.path.join(trace_dir, "*.json")))
     for path in files:
         try:
@@ -48,15 +62,28 @@ def load_events(trace_dir: str):
             print(f"trace_report: skipping unreadable {path}: {e}",
                   file=sys.stderr)
             continue
+        other = doc.get("otherData") if isinstance(doc, dict) else None
+        if isinstance(other, dict) and other.get("merged"):
+            meta["merged"] = True
         for ev in doc.get("traceEvents", doc if isinstance(doc, list) else []):
             ph = ev.get("ph")
+            pid = ev.get("pid")
+            if ph == "M":
+                if ev.get("name") == "process_name":
+                    proc = meta["processes"].setdefault(
+                        pid, {"name": None, "events": 0})
+                    proc["name"] = (ev.get("args") or {}).get("name")
+                continue
+            if pid is not None:
+                meta["processes"].setdefault(
+                    pid, {"name": None, "events": 0})["events"] += 1
             if ph == "X" and "dur" in ev:
                 spans.append(ev)
             elif ph == "i":
                 instants.append(ev)
             elif ph in ("b", "n", "e"):
                 asyncs.append(ev)
-    return spans, instants, asyncs, files
+    return spans, instants, asyncs, files, meta
 
 
 def percentile(sorted_vals, q: float) -> float:
@@ -122,7 +149,7 @@ def summarize_instants(instants):
             for n, c in sorted(counts.items(), key=lambda kv: -kv[1])]
 
 
-def summarize_async(asyncs, wall_ms: float):
+def summarize_async(asyncs, wall_ms: float, merged: bool = False):
     """Async-track events -> (track_rows, step_counts, open_tracks).
 
     Tracks are matched ``b`` -> ``e`` on (pid, cat, id, name) — the
@@ -130,21 +157,41 @@ def summarize_async(asyncs, wall_ms: float):
     same row shape as the span table.  ``n`` step events count per name
     (the lifecycle event mix).  Tracks begun but never ended (requests
     in flight when the trace closed) are reported, not dropped.
+
+    With ``merged=True`` (a fleet_trace.py stitch) the pid leaves the
+    key — a stitched request's events span processes by design — and
+    nested ``b``/``e`` pairs on one id (supervisor span enclosing the
+    child span) are depth-counted: the track's duration is the OUTER
+    span, first ``b`` to the matching last ``e``, i.e. the request's
+    full cross-process journey.
     """
     open_at = {}
     by_name = {}
     steps = {}
     unmatched_end = 0
     for ev in sorted(asyncs, key=lambda e: e.get("ts", 0.0)):
-        key = (ev.get("pid"), ev.get("cat"), ev.get("id"), ev["name"])
+        key = ((ev.get("cat"), ev.get("id"), ev["name"]) if merged
+               else (ev.get("pid"), ev.get("cat"), ev.get("id"),
+                     ev["name"]))
         ph = ev["ph"]
         if ph == "b":
-            open_at[key] = ev["ts"]
+            if merged:
+                t0, depth = open_at.get(key, (ev["ts"], 0))
+                open_at[key] = (t0, depth + 1)
+            else:
+                open_at[key] = ev["ts"]
         elif ph == "e":
-            t0 = open_at.pop(key, None)
-            if t0 is None:
+            rec = open_at.pop(key, None)
+            if rec is None:
                 unmatched_end += 1
                 continue
+            if merged:
+                t0, depth = rec
+                if depth > 1:
+                    open_at[key] = (t0, depth - 1)
+                    continue
+            else:
+                t0 = rec
             by_name.setdefault(ev["name"], []).append(
                 (ev["ts"] - t0) / 1e3)
         else:  # "n": an instant step on the track
@@ -154,6 +201,29 @@ def summarize_async(asyncs, wall_ms: float):
                  for n, c in sorted(steps.items(), key=lambda kv: -kv[1])]
     return rows, step_rows, {"open_tracks": len(open_at),
                              "unmatched_end": unmatched_end}
+
+
+def summarize_processes(meta, instants):
+    """Merged-trace process rows: one per pid with its Perfetto row
+    label, event count, and the clock-skew annotation fleet_trace.py
+    stamped (None for the supervisor row)."""
+    skews = {}
+    for ev in instants:
+        if ev.get("name") == "clock_skew":
+            args = ev.get("args") or {}
+            skews[ev.get("pid")] = args
+    rows = []
+    for pid, proc in sorted(meta["processes"].items(),
+                            key=lambda kv: str(kv[0])):
+        sk = skews.get(pid)
+        rows.append({
+            "pid": pid,
+            "name": proc["name"] or f"pid {pid}",
+            "events": proc["events"],
+            "skew_ms": sk.get("skew_ms") if sk else None,
+            "uncertainty_ms": sk.get("uncertainty_ms") if sk else None,
+        })
+    return rows
 
 
 def print_table(rows, title: str) -> None:
@@ -183,7 +253,7 @@ def main() -> int:
                     help="also write the summary rows as JSON here")
     args = ap.parse_args()
 
-    spans, instants, asyncs, files = load_events(args.trace_dir)
+    spans, instants, asyncs, files, meta = load_events(args.trace_dir)
     if not files:
         print(f"trace_report: no trace files under {args.trace_dir}",
               file=sys.stderr)
@@ -191,16 +261,31 @@ def main() -> int:
     wall_ms = traced_wall_ms(spans, instants, asyncs)
     rows, _ = summarize(spans, wall_ms)
     print_table(rows, f"trace summary: {len(files)} file(s), traced wall "
-                      f"{wall_ms:.1f} ms")
+                      f"{wall_ms:.1f} ms"
+                      + (" [merged fleet trace]" if meta["merged"] else ""))
     if rows:
         print("\nnote: nested spans overlap (e.g. host-path `score` runs "
               "inside `compute`), so pct_of_wall columns need not sum "
               "to 100.")
-    async_rows, step_rows, async_meta = summarize_async(asyncs, wall_ms)
+    proc_rows = []
+    if meta["merged"]:
+        proc_rows = summarize_processes(meta, instants)
+        print()
+        print("process rows (merged fleet trace)")
+        for r in proc_rows:
+            skew = ("-" if r["skew_ms"] is None
+                    else f"{r['skew_ms']:+.3f} ms "
+                         f"(±{r['uncertainty_ms']} ms)")
+            print(f"  {r['name']:<28}  {r['events']} event(s), "
+                  f"clock skew {skew}")
+    async_rows, step_rows, async_meta = summarize_async(
+        asyncs, wall_ms, merged=meta["merged"])
     if async_rows or step_rows:
         print()
         print_table(async_rows,
-                    "async tracks (request lifecycle; b->e durations)")
+                    "async tracks (request lifecycle; b->e durations"
+                    + (", stitched across processes)" if meta["merged"]
+                       else ")"))
         if async_meta["open_tracks"]:
             print(f"  ({async_meta['open_tracks']} track(s) still open — "
                   "in flight when the trace closed)")
@@ -214,6 +299,8 @@ def main() -> int:
     if args.json:
         atomic_json_write(args.json,
                           {"wall_ms": wall_ms, "files": files,
+                           "merged": meta["merged"],
+                           "processes": proc_rows,
                            "spans": rows,
                            "instants": summarize_instants(instants),
                            "async_tracks": async_rows,

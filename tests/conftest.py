@@ -57,6 +57,16 @@ os.environ["CST_SUPERVISE_REPLICAS"] = ""
 os.environ["CST_SUPERVISE_RESTART_LIMIT"] = ""
 os.environ["CST_SUPERVISE_BACKOFF_MS"] = ""
 
+# Fleet-observability / SLO env knobs (ISSUE 17): an operator's exported
+# scrape cadence or SLO targets (opts.py resolves CST_FLEET_*/CST_SLO_*
+# as argparse defaults) must not change what the suite pins.  '' falls
+# back to the built-in defaults; fleetobs tests pass explicit values
+# instead.
+os.environ["CST_FLEET_SCRAPE_MS"] = ""
+os.environ["CST_SLO_P99_MS"] = ""
+os.environ["CST_SLO_AVAILABILITY"] = ""
+os.environ["CST_SLO_ERROR_RATE"] = ""
+
 # Data-plane env knobs (ISSUE 15): an operator's exported worker count or
 # shard assignment (opts.py resolves CST_LOADER_WORKERS/CST_DATA_SHARDS/
 # CST_DATA_SHARD_ID as argparse defaults) must not change what the suite

@@ -1,0 +1,1016 @@
+"""Fleet-wide observability plane (ISSUE 17): cross-process trace
+stitching, continuous metrics aggregation, SLO burn-rate monitoring.
+
+Fast slice (tier-1, NO jax import — the plane is pure host code):
+- :class:`serving.policy.QueryPacer` — the ONE interval/backoff policy
+  the health poll, the metrics scraper and the clock pings share;
+- :class:`telemetry.fleetobs.ClockSync` — midpoint offset estimation
+  (skew = child_wall - (wall_send + rtt/2), uncertainty = rtt/2),
+  min-RTT best sample per child *pid*, bounded pending table;
+- :class:`telemetry.fleetobs.SLOMonitor` — burn formulas per objective,
+  the fast+slow dual-window fire/clear state machine, ``min_requests``
+  guard, typed ``slo_alert`` lifecycle events whose chains the
+  accounting audit counts truncated (never a terminal violation);
+- :class:`telemetry.fleetobs.FleetObs` — scrape cadence, the zero-gap
+  row-per-replica-slot contract across a kill/restart, schema-stamped
+  append-only ``fleet_metrics.jsonl`` + rotation index, the bounded
+  in-memory ring, ``slo_alerts.jsonl`` / ``clock_sync.json`` output;
+- ``scripts/fleet_report.py`` gates (burn-rate violation, scrape
+  blackout, coverage hole, no-samples) and ``scripts/fleet_trace.py``
+  merging (ts rebase by the skew table, child async ids stitched onto
+  the supervisor's request ids, per-process labels, skew instants);
+- ``scripts/trace_report.py``: the legacy single-process rendering
+  pinned unchanged, plus the merged-mode cross-pid track pairing;
+- supervisor integration against a ping-answering fake child: the wire
+  trace stamp (armed vs unarmed), the shared query_child path, the
+  SLO-driven fleet-health degraded flip;
+- the four ``--fleet_scrape_ms`` / ``--slo_*`` flags (env fallbacks,
+  one-line usage errors) and the OBSERVABILITY.md/SERVING.md doc pins.
+
+The real-subprocess drill (3 children, SIGKILL mid-stream, merge +
+report the whole plane end to end) is marked ``slow`` — it is the
+``make fleet-obs-demo`` path under test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cst_captioning_tpu.serving.policy import QueryPacer
+from cst_captioning_tpu.telemetry.fleetobs import (
+    FLEETOBS_COUNTERS,
+    ClockSync,
+    FleetObs,
+    SLOMonitor,
+)
+from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+from test_supervisor import FakeChild, FakeClock, tick_until
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """Sanitizer-armed like every serving/telemetry fast slice: the
+    ring/registry lock order is re-validated under each drill."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists()
+
+
+# -- QueryPacer: the shared child-query policy ------------------------------
+
+
+def test_query_pacer_first_query_always_due():
+    p = QueryPacer(1.0)
+    assert p.due(0, 100.0)          # never queried -> due immediately
+    p.sent(0, 100.0)
+    assert not p.due(0, 100.5)
+    assert p.due(0, 101.0)          # interval elapsed
+
+
+def test_query_pacer_failure_backoff_doubles_capped_then_ok_snaps():
+    p = QueryPacer(1.0, backoff_cap=4)
+    p.sent(0, 100.0)
+    for k, want in ((1, 2.0), (2, 4.0), (3, 4.0)):   # 2x, 4x, cap at 4x
+        p.failed(0)
+        p.sent(0, 100.0)
+        assert not p.due(0, 100.0 + want - 0.01), k
+        assert p.due(0, 100.0 + want), k
+    p.ok(0)
+    p.sent(0, 100.0)
+    assert p.due(0, 101.0)          # back to the base interval
+
+
+def test_query_pacer_forget_resets_key():
+    p = QueryPacer(10.0)
+    p.sent(3, 100.0)
+    p.failed(3)
+    assert not p.due(3, 101.0)
+    p.forget(3)
+    assert p.due(3, 101.0)          # a fresh process is queried NOW
+
+
+# -- ClockSync: the midpoint offset estimate --------------------------------
+
+
+def test_clock_sync_midpoint_math_and_uncertainty():
+    wall = FakeClock(1000.0)
+    cs = ClockSync(wall)
+    ping = cs.ping_payload(0, t0=50.0)
+    assert ping["op"] == "ping" and ping["t0"] == 50.0
+    # Echo arrives 40ms later on the monotonic clock; the child's wall
+    # read was 2.5s ahead of the midpoint estimate.
+    sample = cs.on_echo(0, {"seq": ping["seq"], "wall": 1002.52,
+                            "pid": 777}, t1=50.04)
+    assert sample["pid"] == 777
+    assert sample["rtt_s"] == pytest.approx(0.04)
+    assert sample["uncertainty_s"] == pytest.approx(0.02)
+    # mid_wall = 1000.0 + rtt/2 = 1000.02 -> skew = 2.5
+    assert sample["skew_s"] == pytest.approx(2.5)
+    doc = cs.doc()
+    assert doc["schema"] == 1
+    assert doc["children"]["777"]["skew_s"] == pytest.approx(2.5)
+
+
+def test_clock_sync_keeps_min_rtt_sample_per_pid():
+    wall = FakeClock(1000.0)
+    cs = ClockSync(wall)
+    p1 = cs.ping_payload(0, t0=10.0)
+    cs.on_echo(0, {"seq": p1["seq"], "wall": 1001.0, "pid": 9}, t1=10.2)
+    p2 = cs.ping_payload(0, t0=20.0)
+    cs.on_echo(0, {"seq": p2["seq"], "wall": 1001.0, "pid": 9}, t1=20.02)
+    p3 = cs.ping_payload(0, t0=30.0)
+    cs.on_echo(0, {"seq": p3["seq"], "wall": 1001.0, "pid": 9}, t1=30.5)
+    best = cs.skew_for_pid(9)
+    assert best["rtt_s"] == pytest.approx(0.02)     # the tightest bound
+    assert best["samples"] == 3                     # but every echo counted
+    # A restarted replica is a NEW pid: measured from scratch.
+    p4 = cs.ping_payload(0, t0=40.0)
+    cs.on_echo(0, {"seq": p4["seq"], "wall": 1001.0, "pid": 10}, t1=40.3)
+    assert cs.skew_for_pid(10)["rtt_s"] == pytest.approx(0.3)
+    assert cs.skew_for_pid(9)["rtt_s"] == pytest.approx(0.02)
+
+
+def test_clock_sync_unmatched_and_dropped_pings():
+    cs = ClockSync(FakeClock(0.0))
+    assert cs.on_echo(0, {"seq": 999}, t1=1.0) is None   # never sent
+    ping = cs.ping_payload(2, t0=1.0)
+    cs.drop_pending(2)          # replica 2 got a fresh process
+    assert cs.on_echo(2, {"seq": ping["seq"], "wall": 5.0, "pid": 1},
+                      t1=2.0) is None
+    # The pending table is hard-bounded.
+    for _ in range(ClockSync.MAX_PENDING + 50):
+        cs.ping_payload(0, t0=0.0)
+    assert len(cs._pending) <= ClockSync.MAX_PENDING
+
+
+# -- SLOMonitor: burn formulas + the dual-window state machine --------------
+
+
+def test_slo_disabled_monitor_is_inert():
+    slo = SLOMonitor()
+    assert not slo.enabled
+    slo.observe(False, 1e9, now=0.0)
+    st = slo.evaluate(0.0)
+    assert st == {"enabled": False, "firing": []}
+    assert not slo.alerting and not slo.alerts
+
+
+def test_slo_p99_fires_on_dual_window_burn_and_clears():
+    clk = FakeClock(1000.0)
+    slo = SLOMonitor(p99_ms=10.0, clock=clk, min_requests=4)
+    for _ in range(6):
+        slo.observe(True, 50.0)     # all over target: burn = 1/0.01 = 100
+    st = slo.evaluate()
+    obj = st["objectives"]["p99"]
+    assert obj["firing"] and st["firing"] == ["p99"]
+    assert obj["fast_burn"] == pytest.approx(100.0)
+    assert slo.alerting and slo.alerts_fired == 1
+    assert slo.alerts[-1]["state"] == "firing"
+    # The fast window drains past 60s -> burn 0 -> the alert clears.
+    clk.advance(61.0)
+    st = slo.evaluate()
+    assert st["firing"] == [] and not slo.alerting
+    assert slo.alerts_cleared == 1
+    assert [a["state"] for a in slo.alerts] == ["firing", "cleared"]
+
+
+def test_slo_min_requests_guards_one_bad_second():
+    slo = SLOMonitor(p99_ms=10.0, clock=FakeClock(0.0), min_requests=12)
+    for _ in range(5):
+        slo.observe(True, 99.0)
+    assert not slo.evaluate()["objectives"]["p99"]["firing"]
+    for _ in range(7):
+        slo.observe(True, 99.0)     # now n >= min_requests
+    assert slo.evaluate()["objectives"]["p99"]["firing"]
+
+
+def test_slo_availability_and_error_rate_burn_formulas():
+    slo = SLOMonitor(availability=0.9, error_rate=0.25,
+                     clock=FakeClock(0.0), min_requests=1)
+    for ok in (True, False, True, False):    # 50% errors
+        slo.observe(ok, 1.0)
+    st = slo.evaluate()
+    # availability budget = 0.1 -> burn 5; error_rate budget = 0.25 -> 2.
+    assert st["objectives"]["availability"]["fast_burn"] == \
+        pytest.approx(5.0)
+    assert st["objectives"]["error_rate"]["fast_burn"] == pytest.approx(2.0)
+    assert st["firing"] == ["availability", "error_rate"]
+
+
+def test_slo_alert_lifecycle_events_count_truncated_not_violation():
+    """slo_alert chains have no `received`: the exactly-once terminal
+    audit must report them truncated, never as an accounting failure."""
+    clk = FakeClock(0.0)
+    lc = LifecycleTracer(clock=clk)
+    slo = SLOMonitor(p99_ms=1.0, clock=clk, min_requests=1, lifecycle=lc)
+    for _ in range(3):
+        slo.observe(True, 50.0)
+    slo.evaluate()
+    evs = [e for e in lc.events() if e["kind"] == "slo_alert"]
+    assert evs and evs[-1]["id"] == "slo:p99"
+    assert evs[-1]["state"] == "firing"
+    acc = lc.accounting()
+    assert acc["terminal_ok"] and acc["truncated"] >= 1
+
+
+def test_slo_registry_counters_on_transitions():
+    reg = MetricsRegistry()
+    reg.declare(*FLEETOBS_COUNTERS)
+    clk = FakeClock(0.0)
+    slo = SLOMonitor(p99_ms=1.0, clock=clk, min_requests=1, registry=reg)
+    for _ in range(3):
+        slo.observe(True, 50.0)
+    slo.evaluate()
+    clk.advance(61.0)
+    slo.evaluate()
+    counters = reg.snapshot()["counters"]
+    assert counters["slo_alerts_fired"] == 1
+    assert counters["slo_alerts_cleared"] == 1
+
+
+# -- FleetObs: the scraper ---------------------------------------------------
+
+
+class StubSup:
+    """Duck-typed supervisor surface FleetObs.tick consumes."""
+
+    def __init__(self, clock, n=2):
+        self.clock = clock
+        self.queries = []
+        self.fail = set()
+        self.children = [
+            {"index": k, "state": "ok", "live": True, "restarts": 0,
+             "inflight": 0, "pid": 500 + k, "health": {},
+             "stats": {"queue_depth": k, "latency_p50_ms": 4.0,
+                       "latency_p99_ms": 9.0, "compiles": 2,
+                       "slots": 8, "residents": 2,
+                       "cache_hits": 3, "cache_misses": 1,
+                       "attribution": {"components": {
+                           "decode": {"p99_ms": 5.5}}}}}
+            for k in range(n)]
+
+    def scrape_snapshot(self):
+        return {
+            "fleet": {"replicas": len(self.children),
+                      "in_service": sum(1 for c in self.children
+                                        if c["live"]),
+                      "outstanding": 0, "parked": 0, "completed": 7,
+                      "latency_p50_ms": 4.0, "latency_p99_ms": 9.0},
+            "children": [dict(c) for c in self.children],
+        }
+
+    def query_child(self, index, payload):
+        self.queries.append((index, dict(payload)))
+        return index not in self.fail
+
+
+def make_obs(tmp_path, clk=None, **kw):
+    clk = clk or FakeClock(100.0)
+    kw.setdefault("scrape_interval_s", 1.0)
+    kw.setdefault("wall", FakeClock(5000.0))
+    fo = FleetObs(str(tmp_path / "obs"), clock=clk, **kw)
+    return fo, StubSup(clk), clk
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_fleetobs_scrapes_on_cadence_with_schema_stamp(tmp_path):
+    fo, sup, clk = make_obs(tmp_path)
+    fo.tick(sup, clk())
+    fo.tick(sup, clk())             # same instant: no second sample
+    clk.advance(0.5)
+    fo.tick(sup, clk())             # mid-interval: still one
+    clk.advance(0.5)
+    fo.tick(sup, clk())             # the cadence: two
+    rows = read_jsonl(fo.metrics_path)
+    assert len(rows) == 2 and len(fo.series()) == 2
+    row = rows[0]
+    assert row["schema"] == 1 and row["kind"] == "fleet_sample"
+    assert row["seq"] == 1 and row["interval_ms"] == 1000.0
+    assert row["fleet"]["replicas"] == 2
+    c0 = row["children"][0]
+    assert c0["slot_occupancy"] == pytest.approx(0.25)    # 2/8 slots
+    assert c0["cache_hit_rate"] == pytest.approx(0.75)    # 3/(3+1)
+    assert c0["attribution_p99_ms"] == {"decode": 5.5}
+    # Stats queries went to both live children through query_child.
+    stats_q = [q for q in sup.queries if q[1] == {"op": "stats"}]
+    assert [i for i, _ in stats_q][:2] == [0, 1]
+
+
+def test_fleetobs_zero_gap_rows_cover_dead_replicas(tmp_path):
+    fo, sup, clk = make_obs(tmp_path)
+    fo.tick(sup, clk())
+    sup.children[1].update(live=False, state="backoff", stats=None,
+                           restarts=1)
+    n_alive = len(sup.queries)
+    clk.advance(1.0)
+    fo.tick(sup, clk())
+    rows = read_jsonl(fo.metrics_path)
+    assert [len(r["children"]) for r in rows] == [2, 2]   # zero gaps
+    dead = rows[1]["children"][1]
+    assert dead["live"] is False and dead["state"] == "backoff"
+    assert dead["latency_p99_ms"] is None     # tolerant of missing stats
+    # But no stats/ping queries go to a dead child.
+    sent_while_dead = [q for q in sup.queries[n_alive:] if q[0] == 1]
+    assert not sent_while_dead
+
+
+def test_fleetobs_ping_flow_writes_clock_sync(tmp_path):
+    reg = MetricsRegistry()
+    fo, sup, clk = make_obs(tmp_path, registry=reg)
+    fo.tick(sup, clk())
+    pings = [(i, q) for i, q in sup.queries if q.get("op") == "ping"]
+    assert sorted(i for i, _ in pings) == [0, 1]
+    for idx, ping in pings:
+        fo.on_ping(idx, {"seq": ping["seq"], "wall": 9000.0,
+                         "pid": 500 + idx}, t1=clk())
+    clk.advance(1.0)
+    fo.tick(sup, clk())             # the scrape turn flushes the doc
+    with open(fo.sync_path) as f:
+        doc = json.load(f)
+    assert set(doc["children"]) == {"500", "501"}
+    # rtt 0 on the fake clock: skew is exactly child_wall - wall_send.
+    assert doc["children"]["500"]["skew_s"] == pytest.approx(4000.0)
+    counters = reg.snapshot()["counters"]
+    assert counters["fleet_pings"] >= 2
+    assert counters["fleet_ping_echoes"] == 2
+    assert counters["fleet_samples"] == 2
+    assert counters["fleet_child_rows"] == 4
+
+
+def test_fleetobs_failed_query_backs_off_then_forget_resets(tmp_path):
+    fo, sup, clk = make_obs(tmp_path)
+    sup.fail.add(1)
+    fo.tick(sup, clk())
+    n0 = len([1 for i, q in sup.queries
+              if i == 1 and q.get("op") == "ping"])
+    clk.advance(1.0)
+    fo.tick(sup, clk())             # child 1 backed off: not due at 1x
+    n1 = len([1 for i, q in sup.queries
+              if i == 1 and q.get("op") == "ping"])
+    assert n0 == 1 and n1 == 1
+    fo.on_child_assigned(1)         # fresh process: queried immediately
+    clk.advance(0.1)
+    fo.tick(sup, clk())
+    n2 = len([1 for i, q in sup.queries
+              if i == 1 and q.get("op") == "ping"])
+    assert n2 == 2
+
+
+def test_fleetobs_ring_is_bounded_and_file_is_complete(tmp_path):
+    fo, sup, clk = make_obs(tmp_path, ring_len=8)
+    for _ in range(12):
+        fo.tick(sup, clk())
+        clk.advance(1.0)
+    assert len(fo.series()) == 8                     # bounded view
+    assert fo.series()[-1]["seq"] == 12
+    assert len(read_jsonl(fo.metrics_path)) == 12    # durable: everything
+
+
+def test_fleetobs_rotation_writes_parts_and_atomic_index(tmp_path):
+    fo, sup, clk = make_obs(tmp_path, rotate_rows=16, fsync_every=4)
+    for _ in range(20):
+        fo.tick(sup, clk())
+        clk.advance(1.0)
+    part0 = os.path.join(fo.out_dir, "fleet_metrics_part0.jsonl")
+    assert len(read_jsonl(part0)) == 16
+    assert len(read_jsonl(fo.metrics_path)) == 4
+    with open(os.path.join(fo.out_dir, "fleet_metrics_index.json")) as f:
+        index = json.load(f)
+    assert index["parts"] == ["fleet_metrics_part0.jsonl"]
+    assert index["active"] == "fleet_metrics.jsonl"
+
+
+def test_fleetobs_drains_alerts_and_close_flushes(tmp_path):
+    clk = FakeClock(100.0)
+    slo = SLOMonitor(p99_ms=1.0, clock=clk, min_requests=1)
+    fo, sup, _ = make_obs(tmp_path, clk=clk, slo=slo)
+    for _ in range(3):
+        fo.observe_request(True, 50.0)
+    fo.tick(sup, clk())             # evaluate fires + drains the alert
+    alerts = read_jsonl(fo.alerts_path)
+    assert len(alerts) == 1 and alerts[0]["state"] == "firing"
+    assert fo.alerting
+    assert fo.series()[-1]["slo"]["firing"] == ["p99"]
+    # The clear transition drains on the NEXT scrape turn, and close()
+    # flushes anything still unwritten.
+    clk.advance(61.0)
+    clk.advance(1.0)
+    fo.tick(sup, clk())
+    assert read_jsonl(fo.alerts_path)[-1]["state"] == "cleared"
+    fo.close()
+    fo.tick(sup, clk())             # closed: a late tick is a no-op
+    assert len(read_jsonl(fo.alerts_path)) == 2
+
+
+def test_fleetobs_attaches_slo_provider_to_blackbox(tmp_path):
+    clk = FakeClock(0.0)
+    lc = LifecycleTracer(clock=clk)
+    slo = SLOMonitor(p99_ms=1.0, clock=clk, min_requests=1, lifecycle=lc)
+    fo, sup, _ = make_obs(tmp_path, clk=clk, slo=slo, lifecycle=lc)
+    for _ in range(2):
+        fo.observe_request(True, 9.0)
+    fo.tick(sup, clk())
+    bb = lc.blackbox(reason="test")
+    assert bb["fleet_slo"]["firing"] == ["p99"]
+    acc = bb["accounting"]
+    assert acc["terminal_ok"]       # the slo_alert chain is truncated,
+    assert acc["truncated"] >= 1    # never an accounting violation
+
+
+# -- fleet_report gates ------------------------------------------------------
+
+
+def _mk_sample(seq, wall, *, replicas=2, n_children=None, firing=(),
+               interval_ms=1000.0):
+    n = replicas if n_children is None else n_children
+    return {
+        "schema": 1, "kind": "fleet_sample", "seq": seq, "t": wall,
+        "wall": wall, "interval_ms": interval_ms,
+        "fleet": {"replicas": replicas, "in_service": n, "outstanding": 0,
+                  "parked": 0, "completed": 5 * seq,
+                  "latency_p50_ms": 4.0, "latency_p99_ms": 9.0},
+        "children": [
+            {"index": k, "state": "ok", "live": True, "restarts": 0,
+             "inflight": 0, "queue_depth": 0, "latency_p50_ms": 4.0,
+             "latency_p99_ms": 9.0, "compiles": 2}
+            for k in range(n)],
+        "slo": {"enabled": True, "firing": sorted(firing),
+                "objectives": {"p99": {"target": 50.0, "fast_burn": 0.1,
+                                       "slow_burn": 0.1,
+                                       "firing": bool(firing)}},
+                "alerts_fired": len(firing), "alerts_cleared": 0},
+    }
+
+
+def _run_fleet_report(tmp_path, samples, extra=()):
+    path = tmp_path / "fleet_metrics.jsonl"
+    with open(path, "w") as f:
+        for s in samples:
+            f.write(json.dumps(s) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--file", str(path), *extra],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_fleet_report_healthy_run_renders_and_passes(tmp_path):
+    samples = [_mk_sample(k + 1, 100.0 + k) for k in range(6)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 0, proc.stderr
+    assert "fleet metrics" in proc.stdout
+    assert "child 0" in proc.stdout and "child 1" in proc.stdout
+    assert "slo p99" in proc.stdout and "FIRING" not in proc.stdout
+
+
+def test_fleet_report_gates_on_firing_slo(tmp_path):
+    samples = [_mk_sample(1, 100.0),
+               _mk_sample(2, 101.0, firing=("p99",)),
+               _mk_sample(3, 102.0)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "SLO burn-rate violation" in proc.stderr
+    assert "FIRING" not in proc.stdout  # last sample's view is clean
+
+
+def test_fleet_report_gates_on_scrape_blackout(tmp_path):
+    samples = [_mk_sample(1, 100.0), _mk_sample(2, 101.0),
+               _mk_sample(3, 108.0)]     # 7s gap at a 1s cadence
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "scrape blackout" in proc.stderr
+
+
+def test_fleet_report_gates_on_coverage_hole(tmp_path):
+    samples = [_mk_sample(1, 100.0),
+               _mk_sample(2, 101.0, n_children=1)]   # a missing slot row
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "coverage hole" in proc.stderr and "zero-gap" in proc.stderr
+
+
+def test_fleet_report_no_samples_and_torn_lines(tmp_path):
+    path = tmp_path / "fleet_metrics.jsonl"
+    path.write_text('{"kind": "fleet_sa')      # only a torn line
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "no fleet_sample rows" in proc.stderr
+    # A torn TAIL after good rows is skipped, not fatal.
+    with open(path, "w") as f:
+        f.write(json.dumps(_mk_sample(1, 100.0)) + "\n")
+        f.write('{"kind": "fleet_sa')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_fleet_report_reads_rotated_parts_from_dir(tmp_path):
+    fo, sup, clk = make_obs(tmp_path, rotate_rows=16)
+    for _ in range(20):
+        fo.tick(sup, clk())
+        clk.advance(1.0)
+    fo.close()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--dir", fo.out_dir, "--json", str(tmp_path / "fr.json")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    with open(tmp_path / "fr.json") as f:
+        assert json.load(f)["samples"] == 20      # parts + active file
+
+
+# -- fleet_trace: the cross-process stitch ----------------------------------
+
+
+def _import_fleet_trace():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import fleet_trace
+    finally:
+        sys.path.pop(0)
+    return fleet_trace
+
+
+def _write_trace(path, pid, epoch, events):
+    doc = {"traceEvents":
+           [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": "M"}}] + events,
+           "displayTimeUnit": "ms",
+           "otherData": {"pid": pid, "wall_epoch_unix_s": epoch}}
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _seed_fleet_traces(root):
+    """Supervisor pid 100 (epoch 1000.0) owns request id "7"; replica0
+    pid 200 runs 2.0s fast (epoch 1002.55, true offset 0.55s) and its
+    local track "v1" echoes trace_id 7; replica1 pid 300 has no sync."""
+    sup_events = [
+        {"name": "request", "cat": "lifecycle", "ph": "b", "id": "7",
+         "ts": 0.0, "pid": 100, "tid": 0, "args": {"kind": "received"}},
+        {"name": "routed", "cat": "lifecycle", "ph": "n", "id": "7",
+         "ts": 100.0, "pid": 100, "tid": 0},
+        {"name": "request", "cat": "lifecycle", "ph": "e", "id": "7",
+         "ts": 600000.0, "pid": 100, "tid": 0,
+         "args": {"kind": "completed"}},
+    ]
+    child_events = [
+        {"name": "request", "cat": "lifecycle", "ph": "b", "id": "v1",
+         "ts": 0.0, "pid": 200, "tid": 0,
+         "args": {"kind": "received", "trace_id": 7}},
+        {"name": "decode_chunk", "cat": "lifecycle", "ph": "n",
+         "id": "v1", "ts": 200.0, "pid": 200, "tid": 0},
+        {"name": "request", "cat": "lifecycle", "ph": "e", "id": "v1",
+         "ts": 1500.0, "pid": 200, "tid": 0,
+         "args": {"kind": "completed"}},
+    ]
+    _write_trace(os.path.join(root, "trace", "trace_100r0.json"),
+                 100, 1000.0, sup_events)
+    _write_trace(os.path.join(root, "replica0", "trace",
+                              "trace_200r0.json"), 200, 1002.55,
+                 child_events)
+    _write_trace(os.path.join(root, "replica1", "trace",
+                              "trace_300r0.json"), 300, 1000.2,
+                 [{"name": "host", "cat": "span", "ph": "X", "ts": 10.0,
+                   "dur": 5.0, "pid": 300, "tid": 1}])
+    with open(os.path.join(root, "clock_sync.json"), "w") as f:
+        json.dump({"schema": 1, "supervisor_pid": 100,
+                   "children": {"200": {"index": 0, "pid": 200,
+                                        "skew_s": 2.0,
+                                        "uncertainty_s": 0.002,
+                                        "rtt_s": 0.004, "samples": 3}}},
+                  f)
+
+
+def test_fleet_trace_merges_rebases_and_stitches(tmp_path):
+    ft = _import_fleet_trace()
+    root = str(tmp_path)
+    _seed_fleet_traces(root)
+    summary = ft.merge_fleet_trace(root)
+    assert summary["stitched_tracks"] == 1
+    assert summary["child_pids"] == 2
+    assert summary["missing_sync_pids"] == [300]
+    with open(summary["out"]) as f:
+        doc = json.load(f)
+    other = doc["otherData"]
+    assert other["merged"] is True
+    assert other["base_wall_epoch_unix_s"] == pytest.approx(1000.0)
+    evs = doc["traceEvents"]
+    # Child timeline rebased: corrected epoch 1002.55 - 2.0 = 1000.55,
+    # so its local ts 0 lands at +550000us on the merged timeline; its
+    # async ids are rewritten onto the supervisor's request id.
+    child_b = [e for e in evs if e.get("ph") == "b" and e["pid"] == 200]
+    assert child_b[0]["id"] == "7"
+    assert child_b[0]["ts"] == pytest.approx(550000.0)
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"supervisor (pid 100)", "replica0 (pid 200)",
+                     "replica1 (pid 300)"}
+    skews = {e["pid"]: e["args"] for e in evs
+             if e["name"] == "clock_skew"}
+    assert skews[200]["skew_ms"] == pytest.approx(2000.0)
+    assert skews[200]["synced"] is True
+    assert skews[300]["synced"] is False     # merged with zero skew
+    assert evs == sorted(evs, key=lambda e: e.get("ts", 0.0))
+
+
+def test_fleet_trace_cli_exit_codes(tmp_path):
+    script = os.path.join(REPO, "scripts", "fleet_trace.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--dir", str(tmp_path / "empty")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert "no supervisor trace" in proc.stderr
+    _seed_fleet_traces(str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, script, "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.split("fleet_trace: ", 1)[1])
+    assert summary["stitched_tracks"] == 1
+    assert "WARNING" in proc.stderr      # pid 300 had no sync sample
+
+
+# -- trace_report: merged rendering + the legacy pin ------------------------
+
+
+def _run_trace_report(trace_dir, json_out=None):
+    cmd = [sys.executable, os.path.join(REPO, "scripts",
+                                        "trace_report.py"),
+           "--trace_dir", str(trace_dir)]
+    if json_out:
+        cmd += ["--json", str(json_out)]
+    return subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+
+
+def test_trace_report_merged_view_pairs_across_pids(tmp_path):
+    ft = _import_fleet_trace()
+    _seed_fleet_traces(str(tmp_path))
+    summary = ft.merge_fleet_trace(str(tmp_path),
+                                   str(tmp_path / "out" /
+                                       "fleet_trace.json"))
+    proc = _run_trace_report(tmp_path / "out",
+                             json_out=tmp_path / "tr.json")
+    assert proc.returncode == 0, proc.stderr
+    assert "[merged fleet trace]" in proc.stdout
+    assert "process rows" in proc.stdout
+    assert "stitched across processes" in proc.stdout
+    assert "supervisor (pid 100)" in proc.stdout
+    with open(tmp_path / "tr.json") as f:
+        rep = json.load(f)
+    assert rep["merged"] is True
+    # Depth-counted pairing: the supervisor's b..e encloses the child's
+    # — ONE track whose duration is the outer (cross-process) span.
+    track = {r["span"]: r for r in rep["async_tracks"]}["request"]
+    assert track["count"] == 1
+    assert track["total_ms"] == pytest.approx(600.0)
+    assert rep["async_meta"]["open_tracks"] == 0
+    skew = {int(p["pid"]): p for p in rep["processes"]}
+    assert skew[200]["skew_ms"] == pytest.approx(2000.0)
+
+
+def test_trace_report_single_process_rendering_unchanged(tmp_path):
+    """The legacy pin: a plain (non-merged) trace dir renders with the
+    pid-keyed async pairing and NO merged/process-row sections."""
+    _write_trace(str(tmp_path / "trace_100r0.json"), 100, 1000.0, [
+        {"name": "request", "cat": "lifecycle", "ph": "b", "id": "a",
+         "ts": 0.0, "pid": 100, "tid": 0},
+        {"name": "request", "cat": "lifecycle", "ph": "e", "id": "a",
+         "ts": 2000.0, "pid": 100, "tid": 0},
+        {"name": "compute", "cat": "span", "ph": "X", "ts": 0.0,
+         "dur": 1000.0, "pid": 100, "tid": 1},
+    ])
+    proc = _run_trace_report(tmp_path, json_out=tmp_path / "tr.json")
+    assert proc.returncode == 0, proc.stderr
+    assert "[merged fleet trace]" not in proc.stdout
+    assert "process rows" not in proc.stdout
+    with open(tmp_path / "tr.json") as f:
+        rep = json.load(f)
+    assert rep["merged"] is False
+    track = {r["span"]: r for r in rep["async_tracks"]}["request"]
+    assert track["count"] == 1 and track["total_ms"] == pytest.approx(2.0)
+
+
+# -- supervisor integration --------------------------------------------------
+
+
+class PingFakeChild(FakeChild):
+    """FakeChild + the clock-sync echo (server.py's ping handler) and a
+    unique pid per process life, so per-pid skew tables distinguish a
+    restarted replica."""
+
+    WALL = 9000.0
+    _next_pid = [61000]
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._next_pid[0] += 1
+        self.pid = self._next_pid[0]
+
+    def send_line(self, line):
+        if self.alive and not self.frozen:
+            req = json.loads(line)
+            if req.get("op") == "ping":
+                self.sent.append(line)
+                self._outbox.append(json.dumps(
+                    {"op": "ping", "seq": req.get("seq"),
+                     "t0": req.get("t0"), "mono": 0.0,
+                     "wall": self.WALL, "pid": self.pid}))
+                return
+        super().send_line(line)
+
+
+def build_sup_obs(tmp_path, n=2, *, slo=None, obs_kw=None, **kw):
+    from cst_captioning_tpu.serving.supervisor import ProcessFleetSupervisor
+
+    clock = kw.pop("clock", None) or FakeClock()
+    fo = FleetObs(str(tmp_path / "obs"), clock=clock,
+                  wall=FakeClock(5000.0), slo=slo, **(obs_kw or {}))
+    children = []
+
+    def launcher(k):
+        child = PingFakeChild(k, os.path.join(str(tmp_path),
+                                              f"replica{k}"))
+        children.append(child)
+        return child
+
+    kw.setdefault("backoff_ms", 200.0)
+    kw.setdefault("incident_dir", os.path.join(str(tmp_path), "incidents"))
+    sup = ProcessFleetSupervisor(launcher, n, clock=clock,
+                                 spawn_async=False, fleet_obs=fo, **kw)
+    return sup, children, clock, fo
+
+
+def test_supervisor_stamps_trace_context_only_when_armed(tmp_path):
+    sup, children, clock, fo = build_sup_obs(tmp_path, 1)
+    got = []
+    sup.submit("c1", "v3", respond=got.append)
+    msg = json.loads(children[0].sent[-1])
+    assert msg["trace"]["id"] == msg["id"]
+    assert msg["trace"]["recv_s"] == pytest.approx(clock())
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v3")
+
+    from test_supervisor import build_sup
+    sup2, children2, _ = build_sup(tmp_path / "unarmed", 1)
+    sup2.submit("c2", "v3", respond=[].append)
+    assert "trace" not in json.loads(children2[0].sent[-1])
+
+
+def test_supervisor_clock_sync_end_to_end_and_restart_remeasures(tmp_path):
+    sup, children, clock, fo = build_sup_obs(tmp_path, 2)
+    sup.tick()                   # pings out with the scrape turn
+    sup.tick()                   # echoes pumped in
+    doc = fo.clock_sync.doc()
+    pids = {children[0].pid, children[1].pid}
+    assert {int(p) for p in doc["children"]} == pids
+    # Fake clocks never advance: rtt 0, skew = 9000 - 5000 exactly.
+    for rec in doc["children"].values():
+        assert rec["skew_s"] == pytest.approx(4000.0)
+        assert rec["uncertainty_s"] == 0.0
+    clock.advance(1.1)
+    sup.tick()                   # next scrape turn flushes the table
+    assert os.path.exists(fo.sync_path)
+
+    children[0].kill()
+    sup.tick()                   # reap -> backoff
+    clock.advance(0.5)
+    sup.tick()                   # restart hatches: a NEW pid
+    clock.advance(1.1)
+    sup.tick()                   # fresh process pinged immediately
+    sup.tick()
+    new_pid = [c for c in children if c.replica == 0][-1].pid
+    assert new_pid not in pids
+    assert str(new_pid) in fo.clock_sync.doc()["children"]
+
+
+def test_supervisor_scrape_covers_every_slot_across_restart(tmp_path):
+    sup, children, clock, fo = build_sup_obs(
+        tmp_path, 2, obs_kw={"scrape_interval_s": 0.5})
+    sup.tick()
+    children[1].kill()
+    for _ in range(6):
+        clock.advance(0.5)
+        sup.tick()               # through backoff AND restart
+    rows = read_jsonl(fo.metrics_path)
+    assert len(rows) >= 5
+    assert all(len(r["children"]) == 2 for r in rows)      # zero gaps
+    states = [r["children"][1]["state"] for r in rows]
+    assert "backoff" in states and states[-1] == "ok"
+    assert rows[-1]["children"][1]["restarts"] == 1
+
+
+def test_supervisor_health_poll_is_paced_through_shared_pacer(tmp_path):
+    sup, children, clock, fo = build_sup_obs(tmp_path, 1)
+    sup.tick()
+    sup.tick()                   # same instant: the pacer holds it back
+    health_sent = [l for l in children[0].sent
+                   if json.loads(l).get("op") == "health"]
+    assert len(health_sent) == 1
+    clock.advance(sup.health_interval_s + 0.01)
+    sup.tick()
+    health_sent = [l for l in children[0].sent
+                   if json.loads(l).get("op") == "health"]
+    assert len(health_sent) == 2
+    # The one shared query path answers False for a dead replica.
+    children[0].kill()
+    assert sup.query_child(0, {"op": "health"}) is False
+
+
+def test_supervisor_health_degrades_while_slo_fires(tmp_path):
+    clock = FakeClock()
+    slo = SLOMonitor(p99_ms=1.0, clock=clock, min_requests=1)
+    sup, children, clock, fo = build_sup_obs(tmp_path, 1, slo=slo,
+                                             clock=clock)
+    got = []
+    sup.submit("a", "v1", respond=got.append)
+    clock.advance(0.05)          # 50ms >> the 1ms objective
+    tick_until(sup, lambda: got)
+    clock.advance(1.1)
+    sup.tick()                   # the scrape turn evaluates and fires
+    assert fo.alerting
+    h = sup.health_payload()
+    assert h["status"] == "degraded"       # every replica reports ok...
+    assert h["per_replica"][0]["status"] == "ok"
+    assert h["slo"]["firing"] == ["p99"]
+    assert sup.stats()["slo"]["firing"] == ["p99"]
+    # The supervisor-written terminals count as failed outcomes.
+    sup2, _, clock2, fo2 = build_sup_obs(
+        tmp_path / "b", 1,
+        slo=SLOMonitor(error_rate=0.1, clock=FakeClock(),
+                       min_requests=1))
+    got2 = []
+    sup2.submit("x", "v1", respond=got2.append)
+    sup2.hard_abort()
+    assert got2 and got2[-1].get("error") == "rejected_draining"
+    assert fo2.slo._outcomes and fo2.slo._outcomes[-1][1] is False
+
+
+# -- opts --------------------------------------------------------------------
+
+
+def test_fleet_obs_flags_defaults_env_fallback_and_validation(monkeypatch):
+    from cst_captioning_tpu.opts import parse_opts
+
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.fleet_scrape_ms == 1000
+    assert ns.slo_p99_ms == 0
+    assert ns.slo_availability == 0.0
+    assert ns.slo_error_rate == 0.0
+
+    monkeypatch.setenv("CST_FLEET_SCRAPE_MS", "250")
+    monkeypatch.setenv("CST_SLO_P99_MS", "80")
+    monkeypatch.setenv("CST_SLO_AVAILABILITY", "0.99")
+    monkeypatch.setenv("CST_SLO_ERROR_RATE", "0.05")
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.fleet_scrape_ms == 250
+    assert ns.slo_p99_ms == 80
+    assert ns.slo_availability == pytest.approx(0.99)
+    assert ns.slo_error_rate == pytest.approx(0.05)
+    # Explicit flags beat the environment.
+    ns = parse_opts(["--serve_demo", "1", "--slo_p99_ms", "120"])
+    assert ns.slo_p99_ms == 120
+
+    for argv in (["--fleet_scrape_ms", "0"],
+                 ["--slo_p99_ms", "-1"],
+                 ["--slo_availability", "1.0"],   # zero error budget
+                 ["--slo_availability", "-0.1"],
+                 ["--slo_error_rate", "1.5"],
+                 ["--slo_error_rate", "nope"]):
+        with pytest.raises(SystemExit):
+            parse_opts(argv)
+
+
+def test_ratio_usage_error_is_one_line(capsys):
+    from cst_captioning_tpu.opts import parse_opts
+
+    with pytest.raises(SystemExit):
+        parse_opts(["--slo_availability", "1.0"])
+    err = capsys.readouterr().err
+    msg = [l for l in err.splitlines() if "slo_availability" in l
+           and "error" in l]
+    assert len(msg) == 1
+    assert "[0, 1)" in msg[0] and "CST_SLO_AVAILABILITY" in msg[0]
+
+
+# -- doc pins ----------------------------------------------------------------
+
+
+def test_observability_doc_pins_fleet_plane():
+    with open(os.path.join(REPO, "OBSERVABILITY.md")) as f:
+        text = f.read()
+    for name in FLEETOBS_COUNTERS:
+        assert name in text, f"OBSERVABILITY.md fleet counter: {name}"
+    for token in ("Fleet plane", "fleet_metrics.jsonl", "clock_sync.json",
+                  "slo_alerts.jsonl", "fleet_trace.py", "fleet_report.py",
+                  "--fleet_scrape_ms", "--slo_p99_ms",
+                  "--slo_availability", "--slo_error_rate",
+                  "fleet-obs-demo", "burn"):
+        assert token in text, f"OBSERVABILITY.md Fleet plane: {token!r}"
+
+
+def test_serving_doc_pins_wire_addendum():
+    with open(os.path.join(REPO, "SERVING.md")) as f:
+        text = f.read()
+    for token in ('"op": "ping"', "serve_ping_queries", "trace",
+                  "recv_s"):
+        assert token in text, f"SERVING.md wire addendum: {token!r}"
+
+
+# -- slow: the real-subprocess drill ----------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_obs_probe_drill_end_to_end(tmp_path):
+    """THE acceptance drill: the seeded 3-child SIGKILL probe with the
+    fleet plane armed — scraped series with every slot covered each
+    interval (zero gaps across the restart), clock-synced children, a
+    merged skew-corrected Perfetto file with stitched per-request
+    cross-process tracks, and every report gate green."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = str(tmp_path / "supervise")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_supervisor.py"),
+         "--serve_demo", "1", "--supervise_probe", "1",
+         "--supervise_replicas", "3", "--serve_demo_eos_bias", "-2",
+         "--decode_chunk", "2", "--beam_size", "1",
+         "--fleet_scrape_ms", "200", "--slo_p99_ms", "60000",
+         "--slo_availability", "0.5", "--supervise_dir", root],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    assert rec["slo"]["enabled"] and rec["slo"]["ok"]
+    assert rec["slo"]["firing"] == []
+    assert rec["fleet_obs"]["samples"] >= 1
+    assert rec["fleet_obs"]["clock_synced_pids"] >= 3   # incl. restart
+    assert rec["supervisor"]["requeued"] >= 1           # the kill landed
+
+    # The scraped series: schema-stamped, one row per slot per sample.
+    samples = [r for r in read_jsonl(os.path.join(
+        root, "fleet_metrics.jsonl")) if r.get("kind") == "fleet_sample"]
+    assert samples
+    assert all(r["schema"] == 1 for r in samples)
+    assert all(len(r["children"]) == 3 for r in samples)
+    restarts = max(c["restarts"] for c in samples[-1]["children"])
+    assert restarts >= 1                                # ...and covered it
+
+    # The merge: one Perfetto file, stitched tracks, skew-corrected.
+    merge = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_trace.py"),
+         "--dir", root], capture_output=True, text=True, cwd=REPO)
+    assert merge.returncode == 0, merge.stderr
+    summary = json.loads(merge.stdout.split("fleet_trace: ", 1)[1])
+    assert summary["stitched_tracks"] >= 1
+    assert summary["child_pids"] >= 3
+    assert not summary["missing_sync_pids"]
+
+    # trace_report renders the merged file (root holds fleet_trace.json).
+    tr = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         "--trace_dir", root, "--json", str(tmp_path / "tr.json")],
+        capture_output=True, text=True, cwd=REPO)
+    assert tr.returncode == 0, tr.stderr
+    assert "[merged fleet trace]" in tr.stdout
+    with open(tmp_path / "tr.json") as f:
+        rep = json.load(f)
+    assert rep["merged"] and len(rep["processes"]) >= 4
+    tracks = {r["span"]: r for r in rep["async_tracks"]}
+    assert tracks["request"]["count"] >= 1
+
+    # Both report gates pass: the SLO held, the scrape never went dark.
+    fr = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--dir", root], capture_output=True, text=True, cwd=REPO)
+    assert fr.returncode == 0, fr.stderr
+    assert "fleet metrics" in fr.stdout
+    rec_path = tmp_path / "serving.json"
+    rec_path.write_text(json.dumps(rec) + "\n")
+    sr = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_report.py"),
+         "--file", str(rec_path)], capture_output=True, text=True,
+        cwd=REPO)
+    assert sr.returncode == 0, sr.stderr
+    assert "slo" in sr.stdout
