@@ -36,7 +36,7 @@ TRAIN_COMMON = \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
         serve-chaos serve-fleet-bench serve-fleet-chaos serve-proc-bench \
         serve-proc-chaos serve-trace-demo fleet-obs-demo bf16-parity \
-        data-bench clean
+        data-bench autoscale-bench autoscale-chaos dataset-regen clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -312,6 +312,38 @@ serve-proc-chaos:
 	  $(PY) -m pytest tests/test_supervisor.py -q
 	$(MAKE) serve-proc-bench
 
+# Autoscaler burst drill (SERVING.md "Autoscaling & brownout"): the
+# seeded 3-phase probe (idle -> 4x burst -> idle) through
+# scripts/serve_supervisor.py — starts at --autoscale_min children,
+# must scale up within the scrape-interval budget, drain back down,
+# answer EVERY request exactly once bit-identical to the fault-free
+# single-engine reference, and keep surviving children at zero
+# post-warmup compiles.  serve_report re-gates the probe record
+# (started_at_min / scaled_up / scaled_down / no_thrash / answered_ok)
+# and fleet_report gates the scraped series (scale-event loss, thrash,
+# brownout p99) plus renders the replica timeline.
+autoscale-bench:
+	rm -rf /tmp/cst_autoscale && \
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_supervisor.py --serve_demo 1 \
+	  --autoscale_probe 1 --autoscale_min 1 --autoscale_max 3 \
+	  --autoscale_up_cooldown_s 1 --autoscale_down_cooldown_s 1 \
+	  --serve_demo_eos_bias -2 --decode_chunk 2 --beam_size 1 \
+	  --fleet_scrape_ms 200 --serve_lifecycle 1 \
+	  --slo_p99_ms 60000 --slo_availability 0.5 \
+	  --supervise_dir /tmp/cst_autoscale \
+	  > /tmp/cst_autoscale.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_autoscale.json
+	$(PY) scripts/fleet_report.py --dir /tmp/cst_autoscale
+
+# Autoscaler chaos (SERVING.md "Autoscaling & brownout"): the full
+# tests/test_autoscale.py suite sanitizer-armed — including the slow
+# real-subprocess drills tier-1 skips (SIGKILL mid-scale-event, the
+# CLI burst probe) — then the bench drill + report gates above.
+autoscale-chaos:
+	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
+	  $(PY) -m pytest tests/test_autoscale.py -q
+	$(MAKE) autoscale-bench
+
 # Fleet-observability demo (OBSERVABILITY.md "Fleet plane"): the
 # seeded 3-child supervised drill with the scraper on a 200 ms cadence
 # and loose SLO objectives armed, then (1) stitch the supervisor's and
@@ -378,6 +410,16 @@ scale_chain:
 	$(PY) scripts/scale_chain.py --out_dir /tmp/cst_scale \
 	  --num_videos 6513 --num_val 497 --lr_decay_every 10 \
 	  --stages xe,wxe,cst,cst_scb_sample,eval
+
+# Prove a post-/tmp-wipe dataset rebuild is THE dataset the committed
+# evidence was trained on: regenerate the north-star labels + vocab in
+# a fresh temp dir via the chain's own recipe and compare content
+# hashes (HDF5-mtime-proof) against the committed
+# artifacts/dataset_fingerprint.json — exit 1 on any drift.  After a
+# DELIBERATE spec/grammar change, refresh the record with
+# `$(PY) scripts/dataset_fingerprint.py --update`.
+dataset-regen:
+	JAX_PLATFORMS=cpu $(PY) scripts/dataset_fingerprint.py --check
 
 # Chain status + learning curves + beam tables for the dir above.
 report:

@@ -483,6 +483,8 @@ def bench_serving(args) -> dict:
         zipf_alpha=args.serve_zipf,
         replicas=args.replicas,
         kill_replica=args.serve_kill_replica,
+        arrival_shape=args.arrival_shape,
+        arrival_trace=args.arrival_trace,
         lifecycle=bool(args.serve_trace or args.serve_blackbox),
         blackbox_path=args.serve_blackbox,
     )
@@ -672,6 +674,16 @@ def parse_args():
                    help="--stage serving: write the flight recorder's "
                         "blackbox.json here at probe end (implies "
                         "--serve_trace 1)")
+    p.add_argument("--arrival_shape", default="poisson",
+                   choices=("poisson", "diurnal", "burst", "replay"),
+                   help="--stage serving: open-loop traffic model — "
+                        "seeded Poisson (default), diurnal sinusoid, "
+                        "square-wave burst storms, or JSONL trace "
+                        "replay (serving/bench.make_arrivals)")
+    p.add_argument("--arrival_trace", default=None,
+                   help="--stage serving: JSONL arrival trace (one "
+                        '{"t": seconds} per line) for '
+                        "--arrival_shape replay")
     p.add_argument("--loader_workers", type=int, default=1,
                    help="--stage data: prefetch assembler threads "
                         "(--loader_workers in the trainer).  > 1 also "
@@ -807,6 +819,11 @@ def resolved_config(args) -> dict:
         # a cache entry with a single-engine record.
         config["replicas"] = args.replicas
         config["serve_kill_replica"] = args.serve_kill_replica
+        # The traffic model shapes every latency number (a burst-storm
+        # p99 is not a Poisson p99): part of the identity.  Absent on
+        # pre-arrival-shape arg namespaces = the historical Poisson.
+        config["arrival_shape"] = getattr(args, "arrival_shape",
+                                          "poisson")
         # Lifecycle tracing adds per-event host work to the measured
         # path: a traced record and an untraced one are different
         # measurement protocols and must not share a cache entry.

@@ -46,6 +46,11 @@ HOT_PATHS = (
     # concurrency contracts from day one.
     "cst_captioning_tpu/data/loader.py",
     "cst_captioning_tpu/data/sharding.py",
+    # The autoscaler (ISSUE 19): rides the supervisor's tick thread and
+    # shares its decision state with brownout checks on the submit
+    # path — its state lock must stay declared, and it must never grow
+    # a per-tick device fetch.
+    "cst_captioning_tpu/serving/autoscale.py",
 )
 
 #: Conversions that force a device->host sync when applied to a jax

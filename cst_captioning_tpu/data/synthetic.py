@@ -186,36 +186,19 @@ def _warn_if_degenerate_exposure(captions) -> None:
             "num_videos or shrink rich_vocab.", median)
 
 
-def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpec(),
-             vocab: Vocab | None = None) -> Dict[str, str]:
-    """Write one split's artifact set under ``root``; returns the path map.
+def _write_features(root: str, split: str, spec: SyntheticSpec,
+                    captions: List[List[str]], vocab: Vocab,
+                    rng: np.random.Generator) -> List[str]:
+    """Features: deterministic per-video signal derived from the first
+    caption's token ids, so features genuinely predict captions.
 
-    Pass the train split's vocab when generating val/test so ids agree.
-    """
-    # crc32, not hash(): str hashing is salted per process and would make
-    # regenerated splits differ between interpreter runs.
-    rng = np.random.default_rng(spec.seed + zlib.crc32(split.encode()))
-    captions = _make_captions(rng, spec, vocab=vocab)
-    video_ids = [f"{split}_video{i}" for i in range(spec.num_videos)]
-
-    paths = build_split(
-        [{"id": v, "captions": caps} for v, caps in zip(video_ids, captions)],
-        root, split, max_len=spec.max_len, vocab=vocab,
-    )
-    if split == "train" and spec.rich_vocab:
-        _warn_if_degenerate_exposure(captions)
-    vocab = load_vocab(paths["vocab_json"])
-
-    # Features: deterministic per-video signal derived from the first
-    # caption's token ids, so features genuinely predict captions.
-    #
-    # Tiny grammar: one-hot-ish bucket bumps (tok % dim) — dim >= vocab in
-    # tests, so buckets are collision-free and trivially separable.
-    # Rich grammar: vocab >> dim makes buckets collide 4+ ways; use a
-    # fixed random SIGNATURE per token instead (near-orthogonal dense
-    # vectors) so the word -> feature map stays linearly recoverable at
-    # MSR-VTT vocab/dim ratios — the learnability the real CNN features
-    # have, which bucket collisions destroy.
+    Tiny grammar: one-hot-ish bucket bumps (tok % dim) — dim >= vocab in
+    tests, so buckets are collision-free and trivially separable.
+    Rich grammar: vocab >> dim makes buckets collide 4+ ways; use a
+    fixed random SIGNATURE per token instead (near-orthogonal dense
+    vectors) so the word -> feature map stays linearly recoverable at
+    MSR-VTT vocab/dim ratios — the learnability the real CNN features
+    have, which bucket collisions destroy."""
     feat_paths = []
     sig_rng = np.random.default_rng(spec.seed + 7919)
     n_words = len(vocab) + 1
@@ -238,7 +221,36 @@ def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpe
         with h5py.File(p, "w") as f:
             f.create_dataset("feats", data=feats if t_len > 1 else feats[:, 0, :])
         feat_paths.append(p)
-    paths["feat_h5"] = json.dumps(feat_paths)
+    return feat_paths
+
+
+def generate(root: str, split: str = "train", spec: SyntheticSpec = SyntheticSpec(),
+             vocab: Vocab | None = None, features: bool = True) -> Dict[str, str]:
+    """Write one split's artifact set under ``root``; returns the path map.
+
+    Pass the train split's vocab when generating val/test so ids agree.
+    ``features=False`` skips the (multi-GB at north-star scale) feature
+    h5s — the label-plane-only mode ``scripts/dataset_fingerprint.py``
+    uses, since the dataset's identity is the label h5 + vocab (features
+    are a deterministic function of them via the same seed chain).
+    """
+    # crc32, not hash(): str hashing is salted per process and would make
+    # regenerated splits differ between interpreter runs.
+    rng = np.random.default_rng(spec.seed + zlib.crc32(split.encode()))
+    captions = _make_captions(rng, spec, vocab=vocab)
+    video_ids = [f"{split}_video{i}" for i in range(spec.num_videos)]
+
+    paths = build_split(
+        [{"id": v, "captions": caps} for v, caps in zip(video_ids, captions)],
+        root, split, max_len=spec.max_len, vocab=vocab,
+    )
+    if split == "train" and spec.rich_vocab:
+        _warn_if_degenerate_exposure(captions)
+    vocab = load_vocab(paths["vocab_json"])
+
+    if features:
+        paths["feat_h5"] = json.dumps(
+            _write_features(root, split, spec, captions, vocab, rng))
     return paths
 
 

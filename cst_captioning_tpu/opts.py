@@ -653,6 +653,72 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "burn = error_fraction / target over the sliding "
                         "windows.  0 = disabled.  Env fallback: "
                         "CST_SLO_ERROR_RATE")
+    g.add_argument("--autoscale_min",
+                   type=_positive_int(
+                       "--autoscale_min (or CST_AUTOSCALE_MIN)"),
+                   default=os.environ.get("CST_AUTOSCALE_MIN") or 1,
+                   help="autoscaler (serving/autoscale.py): the fleet "
+                        "never shrinks below this many replicas; with "
+                        "--autoscale_max > 0 the fleet also STARTS "
+                        "here.  Env fallback: CST_AUTOSCALE_MIN")
+    g.add_argument("--autoscale_max",
+                   type=_nonneg_int(
+                       "--autoscale_max (or CST_AUTOSCALE_MAX)",
+                       "autoscaler disabled (fixed-size fleet)"),
+                   default=os.environ.get("CST_AUTOSCALE_MAX") or 0,
+                   help="autoscaler: the ARM switch + upper bound — 0 "
+                        "(default) = fixed --supervise_replicas fleet; "
+                        "N >= --autoscale_min = grow/shrink between the "
+                        "bounds from latency attribution (queue_wait "
+                        "p99 burning while decode p99 stays flat adds a "
+                        "replica; a full quiet slow window retires one) "
+                        "and enter the brownout ladder when pinned at "
+                        "max (SERVING.md 'Autoscaling & brownout').  "
+                        "Env fallback: CST_AUTOSCALE_MAX")
+    g.add_argument("--autoscale_queue_hi_ms",
+                   type=_positive_int(
+                       "--autoscale_queue_hi_ms "
+                       "(or CST_AUTOSCALE_QUEUE_HI_MS)"),
+                   default=(os.environ.get("CST_AUTOSCALE_QUEUE_HI_MS")
+                            or 50),
+                   help="autoscaler: queue_wait-attribution p99 (ms) "
+                        "over which the dual-window up-signal burns; "
+                        "the down-signal's quiet threshold is a tenth "
+                        "of this (hysteresis).  Env fallback: "
+                        "CST_AUTOSCALE_QUEUE_HI_MS")
+    g.add_argument("--autoscale_up_cooldown_s",
+                   type=_nonneg_int(
+                       "--autoscale_up_cooldown_s "
+                       "(or CST_AUTOSCALE_UP_COOLDOWN_S)",
+                       "no scale-up cooldown"),
+                   default=(os.environ.get("CST_AUTOSCALE_UP_COOLDOWN_S")
+                            or 2),
+                   help="autoscaler: seconds between scale-ups (thrash "
+                        "damping; held decisions are counted, not "
+                        "lost).  Env fallback: "
+                        "CST_AUTOSCALE_UP_COOLDOWN_S")
+    g.add_argument("--autoscale_down_cooldown_s",
+                   type=_nonneg_int(
+                       "--autoscale_down_cooldown_s "
+                       "(or CST_AUTOSCALE_DOWN_COOLDOWN_S)",
+                       "no scale-down cooldown"),
+                   default=(os.environ.get(
+                       "CST_AUTOSCALE_DOWN_COOLDOWN_S") or 10),
+                   help="autoscaler: seconds between scale-downs — "
+                        "deliberately longer than the up cooldown "
+                        "(shrinking is cheap to defer, growing is "
+                        "not).  Env fallback: "
+                        "CST_AUTOSCALE_DOWN_COOLDOWN_S")
+    g.add_argument("--autoscale_probe", type=int, default=0,
+                   help="1 = scripts/serve_supervisor.py runs the "
+                        "seeded 3-phase autoscale drill (idle -> 4x "
+                        "burst -> idle) instead of serving: the fleet "
+                        "starts at --autoscale_min, scales up within "
+                        "the scrape budget, scales back down, every "
+                        "request answered exactly once bit-identical "
+                        "to a fixed-size fault-free reference, zero "
+                        "post-warmup compiles on surviving children; "
+                        "emits the benchmark record line")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:

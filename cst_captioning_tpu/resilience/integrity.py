@@ -173,6 +173,17 @@ def atomic_json_write(path: str, doc, **dump_kwargs) -> None:
     fsync_dir(os.path.dirname(path))
 
 
+def durable_rename(src: str, dst: str) -> None:
+    """The repo's one durable-rename discipline: ``os.replace`` then
+    fsync the destination directory, so a crash can't journal the
+    rename away.  Every rename that PUBLISHES a durable artifact (part
+    rotation, step promotion, quarantine moves) must go through here —
+    a bare ``os.replace`` persists the data blocks but can lose the
+    directory entry, which reads back as the file never existing."""
+    os.replace(src, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
 def fsync_dir(path: str) -> None:
     """Persist directory-entry changes (renames, creates).  Best-effort:
     some filesystems refuse O_RDONLY-fsync on directories; the data-file

@@ -65,6 +65,104 @@ def poisson_arrivals(num_requests: int, rate_hz: float,
                                      size=int(num_requests)))
 
 
+def _thinned_arrivals(num_requests: int, peak_hz: float,
+                      rate_at, seed: int) -> np.ndarray:
+    """Lewis–Shedler thinning: draw candidate gaps at the PEAK rate,
+    accept each candidate with probability ``rate_at(t)/peak`` — an
+    exact non-homogeneous Poisson process, deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    out = np.empty(int(num_requests), dtype=np.float64)
+    t = 0.0
+    k = 0
+    peak = float(peak_hz)
+    while k < out.size:
+        t += rng.exponential(1.0 / peak)
+        if rng.random() * peak <= rate_at(t):
+            out[k] = t
+            k += 1
+    return out
+
+
+def diurnal_arrivals(num_requests: int, rate_hz: float, seed: int = 0,
+                     period_s: float = 60.0,
+                     depth: float = 0.9) -> np.ndarray:
+    """Seeded diurnal sinusoid: the mean rate is ``rate_hz`` but the
+    instantaneous rate swings ``±depth`` around it over ``period_s`` —
+    the compressed day/night cycle of user traffic."""
+    base = float(rate_hz)
+    d = min(max(float(depth), 0.0), 1.0)
+    w = 2.0 * np.pi / float(period_s)
+
+    def rate_at(t: float) -> float:
+        return base * (1.0 + d * np.sin(w * t))
+
+    return _thinned_arrivals(num_requests, base * (1.0 + d),
+                             rate_at, seed)
+
+
+def burst_arrivals(num_requests: int, rate_hz: float, seed: int = 0,
+                   period_s: float = 8.0, duty: float = 0.25,
+                   burst_factor: float = 4.0) -> np.ndarray:
+    """Square-wave burst storms: quiet at ``rate_hz`` for most of each
+    ``period_s``, then a ``burst_factor``x storm for the ``duty``
+    fraction — the traffic the fleet was NOT sized for (the autoscale
+    drill's shape)."""
+    base = float(rate_hz)
+    f = max(1.0, float(burst_factor))
+    du = min(max(float(duty), 0.0), 1.0)
+    p = float(period_s)
+
+    def rate_at(t: float) -> float:
+        return base * f if (t % p) < du * p else base
+
+    return _thinned_arrivals(num_requests, base * f, rate_at, seed)
+
+
+def replay_arrivals(path: str, num_requests: int) -> np.ndarray:
+    """Arrival times replayed from a JSONL trace (one ``{"t": seconds}``
+    object per line — the shape fleet_metrics/lifecycle tooling can
+    produce from production logs).  Times are sorted and rebased to 0;
+    the trace must supply at least ``num_requests`` events (extra
+    events are truncated)."""
+    import json as _json
+
+    ts = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ts.append(float(_json.loads(line)["t"]))
+    n = int(num_requests)
+    if len(ts) < n:
+        raise ValueError(
+            f"arrival trace {path} has {len(ts)} events, need {n}")
+    arr = np.sort(np.asarray(ts, dtype=np.float64))[:n]
+    return arr - arr[0]
+
+
+def make_arrivals(shape: str, num_requests: int, rate_hz: float,
+                  seed: int = 0,
+                  trace_path: Optional[str] = None) -> np.ndarray:
+    """Dispatch on ``--arrival_shape``: the one place the probe's
+    traffic models live, so the CLI choices and the generators cannot
+    drift apart."""
+    if shape == "poisson":
+        return poisson_arrivals(num_requests, rate_hz, seed)
+    if shape == "diurnal":
+        return diurnal_arrivals(num_requests, rate_hz, seed)
+    if shape == "burst":
+        return burst_arrivals(num_requests, rate_hz, seed)
+    if shape == "replay":
+        if not trace_path:
+            raise ValueError(
+                "--arrival_shape replay needs --arrival_trace")
+        return replay_arrivals(trace_path, num_requests)
+    raise ValueError(
+        f"unknown arrival shape {shape!r} "
+        "(expected poisson|diurnal|burst|replay)")
+
+
 def zipfian_mix(num_requests: int, unique_videos: int, alpha: float,
                 seed: int = 0) -> np.ndarray:
     """Video index per request: rank-``1/r^alpha`` draws over the unique
@@ -89,6 +187,8 @@ def serving_probe(model, variables, feat_shapes: Sequence,
                   unique_videos: Optional[int] = None,
                   zipf_alpha: float = 0.0,
                   replicas: int = 1, kill_replica: int = -1,
+                  arrival_shape: str = "poisson",
+                  arrival_trace: Optional[str] = None,
                   lifecycle: bool = False,
                   blackbox_path: Optional[str] = None,
                   registry=None, tracer=None,
@@ -103,7 +203,8 @@ def serving_probe(model, variables, feat_shapes: Sequence,
     """
     n = int(num_requests)
     uniq = n if unique_videos is None else max(1, min(int(unique_videos), n))
-    arrivals = poisson_arrivals(n, rate_hz, seed)
+    arrivals = make_arrivals(arrival_shape, n, rate_hz, seed,
+                             trace_path=arrival_trace)
     feat_rng = np.random.default_rng(seed + 1)
     feats = [
         [feat_rng.standard_normal(s).astype(np.float32)
@@ -333,6 +434,7 @@ def serving_probe(model, variables, feat_shapes: Sequence,
         "shed": shed,
         "dropped": dropped,
         "rate_hz": float(rate_hz),
+        "arrival_shape": str(arrival_shape),
         "arrival_seed": int(seed),
         "unique_videos": uniq,
         "zipf_alpha": float(zipf_alpha),

@@ -54,16 +54,19 @@ def worst_status(statuses: Iterable[str]) -> str:
 
 
 def deadline_unmeetable(ttl_ms: float,
-                        floors_s: Iterable[Optional[float]]) -> bool:
+                        floors_s: Iterable[Optional[float]],
+                        margin: float = 1.0) -> bool:
     """True when ``ttl_ms`` is provably below every candidate's service
     floor (one p99 decode chunk, seconds) — the fleet-edge shed test.
     Conservative: any unknown floor (``None``, a replica whose latency
     window is not yet honest) makes the answer False — never shed on a
-    guess."""
+    guess.  ``margin`` inflates the floors (brownout rung 1 tightens
+    admission by demanding margin-x headroom); the default 1.0 is the
+    plain provably-unmeetable test."""
     floors = list(floors_s)
     if not floors or any(f is None for f in floors):
         return False
-    return float(ttl_ms) / 1e3 < min(floors)
+    return float(ttl_ms) / 1e3 < min(floors) * float(margin)
 
 
 class QueryPacer:

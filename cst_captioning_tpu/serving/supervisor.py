@@ -136,6 +136,8 @@ SUPERVISOR_COUNTERS = (
     "sup_wedge_kills",        # line-silent children killed as exit 124
     "sup_incidents",          # incident bundles harvested
     "sup_bad_lines",          # unparseable/unattributable child lines
+    "sup_replicas_added",     # autoscale grow: new replica slots spawned
+    "sup_replicas_retired",   # autoscale shrink: slots drained out
 )
 
 #: Declared acquisition order (cstlint:lock-order + the runtime
@@ -330,7 +332,10 @@ class ProcReplica:
     """Supervisor-side bookkeeping for one OS-process replica slot.
     ``state``: ``starting`` (spawn in flight) → ``ok`` (serving) →
     ``backoff`` (dead, restart scheduled) → ``dead`` (budget spent) —
-    plus ``drained`` once a fleet drain retires it."""
+    plus ``drained`` once a fleet drain retires it, and ``retired``
+    once an autoscale scale-down drains the slot out of service
+    (terminal like ``dead``, but deliberate: it never degrades fleet
+    health and is never restarted)."""
 
     def __init__(self, index: int):
         self.index = int(index)
@@ -352,6 +357,7 @@ class ProcReplica:
         self.completed = 0
         self.kill_at: Optional[float] = None   # pending deliberate-kill
                                                # deadline (real monotonic)
+        self.retiring = False      # autoscale drain-out in progress
 
     @property
     def live(self) -> bool:
@@ -374,7 +380,7 @@ class ProcessFleetSupervisor:
                  dump_grace_s: float = 2.0,
                  incident_dir: Optional[str] = None,
                  fault_plan=None, registry=None, lifecycle=None,
-                 fleet_obs=None,
+                 fleet_obs=None, autoscaler=None,
                  clock: Callable[[], float] = time.monotonic,
                  spawn_async: bool = True):
         n = int(replicas)
@@ -397,6 +403,12 @@ class ProcessFleetSupervisor:
         # None costs one is-None check per call site (the house rule),
         # and keeps the wire byte-identical for unarmed fleets.
         self._fleet_obs = fleet_obs
+        # Optional autoscaler (serving/autoscale.py, ISSUE 19): rides
+        # the tick right after the scraper, grows/shrinks the slot list
+        # through add_replica()/retire_worst(), and its brownout rung
+        # tightens the shed paths — same one-is-None-check-per-site
+        # rule as fleet_obs.
+        self._autoscaler = autoscaler
         self.clock = clock
         self.spawn_async = spawn_async
         # Single-owner scheduler state (the module-docstring contract).
@@ -506,7 +518,8 @@ class ProcessFleetSupervisor:
     def _check_unrecoverable(self) -> None:
         if self._draining:
             return
-        if all(r.state in ("dead", "drained") for r in self._replicas):
+        if all(r.state in ("dead", "drained", "retired")
+               for r in self._replicas):
             raise SupervisorUnrecoverable(
                 "every replica is dead (fatal-exit budget "
                 f"{self.restart_limit} exhausted fleet-wide)")
@@ -566,6 +579,77 @@ class ProcessFleetSupervisor:
                 continue
             self._assign_child(rep, child)
 
+    # -- autoscale: grow / shrink the slot list ----------------------------
+
+    def active_replicas(self) -> int:
+        """Slots still in service or coming up — the autoscaler's
+        notion of fleet size (terminal slots don't count)."""
+        return sum(1 for r in self._replicas
+                   if r.state not in ("dead", "drained", "retired"))
+
+    def add_replica(self) -> int:
+        """Append one replica slot and spawn it through the existing
+        warm child recipe (the launcher IS `spawn_serve_child` in real
+        fleets, so the new child pays zero post-warmup compiles).
+        Mirrors `_restart_due`'s sync/async split; returns the new slot
+        index immediately — the child lands via `_hatch_ready` (async)
+        or inline (sync)."""
+        rep = ProcReplica(len(self._replicas))
+        self._replicas.append(rep)
+        self._inc("sup_replicas_added")
+        self._dirty = True
+        log.info("supervisor: autoscale adding replica %d", rep.index)
+        if not self.spawn_async:
+            try:
+                child = self._launcher(rep.index)
+            except Exception as e:
+                self._spawn_failed(rep, e)
+            else:
+                self._assign_child(rep, child)
+            return rep.index
+        self._spawning.add(rep.index)
+
+        def run(ix: int = rep.index) -> None:
+            # Helper-thread body: ONLY the launcher and the hatch
+            # queue — no supervisor state (thread-ownership law).
+            try:
+                child = self._launcher(ix)
+            except Exception as e:  # hatched as a failed start
+                self._hatch.put((ix, None, e))
+            else:
+                self._hatch.put((ix, child, None))
+
+        threading.Thread(target=run, name=f"sup-spawn-{rep.index}",
+                         daemon=True).start()
+        return rep.index
+
+    def retire_worst(self) -> Optional[int]:
+        """Drain the worst-ranked live child out of service (autoscale
+        scale-down).  Strictly drain-based: ``terminate()`` flips the
+        child to draining, in-flight work finishes, queue rejections
+        flow back as ``rejected_draining`` and requeue elsewhere, and
+        the eventual exit lands in `_on_death`'s retiring path.  Picks
+        via the SHARED ``policy.rank_key`` (degraded first, then most
+        loaded, then highest index) so "worst" cannot fork between
+        placement and retirement.  Refuses (returns None) when it
+        would leave no serving candidate."""
+        cands = [r for r in self._replicas
+                 if r.live and not r.retiring and r.kill_at is None]
+        if len(cands) <= 1:
+            return None
+        worst = max(cands, key=lambda r: rank_key(
+            r.health.get("status") == "degraded",
+            len(r.inflight), r.index))
+        worst.retiring = True
+        self._dirty = True
+        log.info("supervisor: autoscale retiring replica %d (drain, "
+                 "%d in flight)", worst.index, len(worst.inflight))
+        try:
+            worst.child.terminate()
+        except OSError:
+            pass
+        return worst.index
+
     def _reap_exits(self) -> None:
         for rep in self._replicas:
             if rep.child is None:
@@ -592,7 +676,8 @@ class ProcessFleetSupervisor:
         rep.child = None
         rep.kill_at = None
         self._dirty = True
-        expected = self._draining and cls in ("ok", "resumable")
+        expected = ((self._draining or rep.retiring)
+                    and cls in ("ok", "resumable"))
         if not expected:
             self._harvest_incident(rep, rc, cls)
         orphans = [self._pending[i] for i in sorted(rep.inflight)
@@ -604,6 +689,30 @@ class ProcessFleetSupervisor:
             rep.state = "drained"
             for pr in orphans:
                 self._answer_reject_draining(pr)
+            return
+        if rep.retiring:
+            # A deliberate autoscale drain-out: the exit is the POINT,
+            # so no restart and no budget charge.  A clean/resumable
+            # exit retires the slot quietly; anything else already
+            # harvested an incident above.  Orphans (a child that died
+            # MID-drain with work aboard) fall through the ordinary
+            # requeue below — exactly-once is preserved by the same
+            # path a crash uses.
+            rep.state = "retired"
+            rep.retiring = False
+            self._inc("sup_replicas_retired")
+            log.info("supervisor: replica %d retired (autoscale "
+                     "scale-down, rc=%d)", rep.index, rc)
+            for pr in orphans:
+                pr.requeues += 1
+                pr.cur_tokens = 0
+                pr.tried = {rep.index}
+                self._inc("sup_requeued")
+                if self._lifecycle is not None:
+                    self._lifecycle.emit("killed", pr.sup_id,
+                                         replica=rep.index, rc=rc)
+                    self._lifecycle.emit("requeued", pr.sup_id)
+                self._place(pr, reroute=True)
             return
         # Classify-then-schedule BEFORE requeue, so placement sees this
         # replica in its true (non-candidate) state.
@@ -805,24 +914,26 @@ class ProcessFleetSupervisor:
                 "index": rep.index, "state": rep.state, "live": rep.live,
                 "restarts": rep.restarts,
                 "inflight": len(rep.inflight),
+                "retiring": rep.retiring,
                 "pid": (rep.child.pid if rep.child is not None else None),
                 "health": dict(rep.health),
                 "stats": (dict(rep.last_stats)
                           if rep.last_stats is not None else None),
             })
-        return {
-            "fleet": {
-                "replicas": len(self._replicas),
-                "in_service": sum(1 for r in self._replicas if r.live),
-                "outstanding": len(self._pending),
-                "parked": parked,
-                "completed": self._completed,
-                "latency_p50_ms": pct(50),
-                "latency_p99_ms": pct(99),
-                "supervisor": self.supervisor_counters(),
-            },
-            "children": children,
+        fleet = {
+            "replicas": len(self._replicas),
+            "active": self.active_replicas(),
+            "in_service": sum(1 for r in self._replicas if r.live),
+            "outstanding": len(self._pending),
+            "parked": parked,
+            "completed": self._completed,
+            "latency_p50_ms": pct(50),
+            "latency_p99_ms": pct(99),
+            "supervisor": self.supervisor_counters(),
         }
+        if self._autoscaler is not None:
+            fleet["autoscale"] = self._autoscaler.status()
+        return {"fleet": fleet, "children": children}
 
     def _update_snapshots(self) -> None:
         snaps: List[Dict[str, Any]] = []
@@ -832,6 +943,8 @@ class ProcessFleetSupervisor:
                 status = h.get("status", "ok")
             elif rep.state in ("starting", "backoff"):
                 status = "restarting"
+            elif rep.state == "retired":
+                status = "retired"
             else:
                 status = "dead"
             snaps.append({
@@ -869,7 +982,12 @@ class ProcessFleetSupervisor:
             totals = dict(self._totals)
             with self._requeue_lock:
                 parked = len(self._parked)
-        status = worst_status(s["status"] for s in per)
+        # A retired slot is a DELIBERATE absence (autoscale scale-down)
+        # — it must never degrade the worst-of view the way a dead or
+        # restarting slot does.  All-retired cannot outlive a tick
+        # (_check_unrecoverable), so the filtered view stays honest.
+        status = worst_status(s["status"] for s in per
+                              if s["status"] != "retired")
         out: Dict[str, Any] = {}
         if self._fleet_obs is not None:
             if self._fleet_obs.alerting:
@@ -910,6 +1028,7 @@ class ProcessFleetSupervisor:
 
         out = {
             "replicas": len(self._replicas),
+            "active": self.active_replicas(),
             "in_service": sum(1 for r in self._replicas if r.live),
             "outstanding": len(self._pending),
             "parked": parked,
@@ -922,6 +1041,8 @@ class ProcessFleetSupervisor:
         }
         if self._fleet_obs is not None:
             out["slo"] = self._fleet_obs.slo_status()
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.status()
         return out
 
     # -- routing -----------------------------------------------------------
@@ -947,6 +1068,20 @@ class ProcessFleetSupervisor:
         if self._draining:
             self._answer_reject_draining(pr)
             return
+        if (pr.stream and self._autoscaler is not None
+                and self._autoscaler.brownout_rung() >= 3):
+            # Brownout rung 3 (the last before collapse): new stream
+            # ops — the long-held, token-by-token kind — are rejected
+            # at intake with a typed shed; one-shot captions still
+            # flow through admission.
+            self._autoscaler.note_shed("stream")
+            self._inc("sup_shed")
+            self._finish(pr, {"id": pr.client_id, "error": "shed",
+                              "video_id": pr.video_id,
+                              "why": "brownout_stream"},
+                         "shed", where="fleet",
+                         reason="brownout_stream")
+            return
         self._place(pr)
 
     def _candidates(self, tried: Set[int]) -> List[ProcReplica]:
@@ -956,7 +1091,7 @@ class ProcessFleetSupervisor:
         in-flight count as the load, index tiebreak."""
         active = [r for r in self._replicas
                   if r.live and r.kill_at is None
-                  and r.index not in tried]
+                  and not r.retiring and r.index not in tried]
         return sorted(active, key=lambda r: rank_key(
             r.health.get("status") == "degraded",
             len(r.inflight), r.index))
@@ -980,14 +1115,28 @@ class ProcessFleetSupervisor:
                 self._check_unrecoverable()
             self._answer_shed(pr)
             return
-        if rem is not None and deadline_unmeetable(
-                rem, (None if s.health.get("min_service_ms") is None
+        if rem is not None:
+            floors = [None if s.health.get("min_service_ms") is None
                       else float(s.health["min_service_ms"]) / 1e3
-                      for s in cands)):
-            # Provably unmeetable EVERYWHERE: shed at the fleet edge
-            # with an explicit answer (SERVING.md "Fleet").
-            self._answer_expired(pr, why="deadline_unmeetable")
-            return
+                      for s in cands]
+            if deadline_unmeetable(rem, floors):
+                # Provably unmeetable EVERYWHERE: shed at the fleet edge
+                # with an explicit answer (SERVING.md "Fleet").
+                self._answer_expired(pr, why="deadline_unmeetable")
+                return
+            if (self._autoscaler is not None
+                    and self._autoscaler.brownout_rung() >= 1
+                    and deadline_unmeetable(
+                        rem, floors,
+                        margin=self._autoscaler.deadline_margin)):
+                # Brownout rung 1: the fleet is pinned at max and still
+                # burning, so admission tightens — a deadline without
+                # margin-x headroom over every service floor is shed
+                # NOW rather than admitted to miss (SERVING.md
+                # "Autoscaling & brownout").
+                self._autoscaler.note_shed("deadline")
+                self._answer_expired(pr, why="brownout_deadline")
+                return
         msg: Dict[str, Any] = {"id": pr.sup_id, "video_id": pr.video_id,
                                "op": "stream" if pr.stream else "caption"}
         if rem is not None:
@@ -1023,6 +1172,22 @@ class ProcessFleetSupervisor:
         self._park(pr)
 
     def _park(self, pr: ProxyRequest) -> None:
+        if (self._autoscaler is not None
+                and self._autoscaler.brownout_rung() >= 2):
+            with self._requeue_lock:
+                depth = len(self._parked)
+            if depth >= self._autoscaler.parked_cap:
+                # Brownout rung 2: the hold queue is capacity the fleet
+                # no longer has — overflow is shed honestly with a
+                # typed answer instead of parking into a miss.
+                self._autoscaler.note_shed("parked")
+                self._inc("sup_shed")
+                self._finish(pr, {"id": pr.client_id, "error": "shed",
+                                  "video_id": pr.video_id,
+                                  "why": "brownout_parked"},
+                             "shed", where="fleet",
+                             reason="brownout_parked")
+                return
         pr.replica = None
         pr.tried = set()   # a fresh attempt reconsiders everyone
         self._inc("sup_parked")
@@ -1261,6 +1426,10 @@ class ProcessFleetSupervisor:
         self._health_poll(now)
         if self._fleet_obs is not None:
             self._fleet_obs.tick(self, now)
+        if self._autoscaler is not None and not self._draining:
+            # Right after the scraper: the autoscaler decides from the
+            # sample the scraper may just have appended, same tick.
+            self._autoscaler.tick(self, now)
         self._retry_parked(now)
         if self._dirty:
             self._dirty = False

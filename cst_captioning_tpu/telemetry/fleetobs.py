@@ -522,6 +522,7 @@ class FleetObs:
             "live": bool(child.get("live")),
             "restarts": child.get("restarts", 0),
             "inflight": child.get("inflight", 0),
+            "retiring": bool(child.get("retiring")),
             "queue_depth": st.get("queue_depth"),
             "latency_p50_ms": st.get("latency_p50_ms"),
             "latency_p99_ms": st.get("latency_p99_ms"),
@@ -565,7 +566,7 @@ class FleetObs:
             self._rotate()
 
     def _rotate(self) -> None:
-        from ..resilience.integrity import atomic_json_write
+        from ..resilience.integrity import atomic_json_write, durable_rename
 
         if self._fh is not None:
             os.fsync(self._fh.fileno())
@@ -573,7 +574,10 @@ class FleetObs:
             self._fh = None
         part_path = os.path.join(
             self.out_dir, f"fleet_metrics_part{self._part}.jsonl")
-        os.replace(self.metrics_path, part_path)
+        # durable_rename, not bare os.replace: the advisor-flagged
+        # straggler — a crash between the rename and the index write
+        # could journal the part's directory entry away.
+        durable_rename(self.metrics_path, part_path)
         self._part += 1
         self._rows_in_part = 0
         atomic_json_write(

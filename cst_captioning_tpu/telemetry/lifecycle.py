@@ -83,6 +83,10 @@ EVENT_KINDS = (
                      # the objective name, not a request; its chain has
                      # no `received` so accounting counts it truncated,
                      # never a terminal violation (telemetry/fleetobs.py)
+    "autoscale_decision",  # autoscaler scale/brownout decision (attrs:
+                     # action, replicas_before/after, rung) — id is the
+                     # decision seq, not a request; same truncated-chain
+                     # accounting as slo_alert (serving/autoscale.py)
 )
 
 #: The kinds that END a request's story exactly once.  ``responded`` is

@@ -312,7 +312,10 @@ class CheckpointManager:
                 dst = step_dir + self.QUARANTINE_SUFFIX
                 try:
                     shutil.rmtree(dst, ignore_errors=True)
-                    os.rename(step_dir, dst)
+                    # durable_rename, not bare os.rename: a crash right
+                    # after quarantining could journal the rename away
+                    # and resurrect the torn step on the next scan.
+                    integrity.durable_rename(step_dir, dst)
                 except OSError as e:
                     log.warning("could not quarantine torn step %s: %s",
                                 step_dir, e)

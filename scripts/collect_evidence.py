@@ -108,6 +108,10 @@ def main() -> int:
     take("slo_alerts.jsonl")
     take("clock_sync.json")
     take("fleet_trace.json")
+    # The autoscaler's durable decision log (SERVING.md "Autoscaling &
+    # brownout"): every scale-up/scale-down/brownout transition with
+    # the attribution evidence it acted on.
+    take("autoscale_decisions.jsonl")
 
     # Regenerate the report against the live out_dir so report + copies
     # agree, then keep both renderings.  A wedged/killed chain_report must

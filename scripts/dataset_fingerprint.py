@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Regenerate + SHA-fingerprint the north-star dataset (`make
+dataset-regen`, VERDICT item 8).
+
+The scale chain's dataset lives in /tmp (scripts/scale_chain.py) — a
+host wipe deletes it, and "just regenerate it" is only trustworthy if
+the rebuild is PROVABLY the same dataset the committed evidence was
+trained on.  This tool regenerates via the same ``generate_data``
+recipe the chain uses and fingerprints the artifacts that define the
+dataset's identity: the label h5 and the vocab json, per split.
+
+Fingerprints are CONTENT hashes, not file hashes: HDF5 embeds object
+modification times in its headers, so the raw bytes of two identical
+regenerations differ — instead we hash every dataset's (name, shape,
+dtype, array bytes) in sorted name order, and the vocab as canonical
+JSON.  Feature h5s are derived deterministically from the label plane
+(same seed chain) and are multi-GB, so the label+vocab pair IS the
+identity; ``--labels_only`` (default) skips feature synthesis.
+
+    # prove a post-/tmp-wipe rebuild identical to the committed record:
+    make dataset-regen          # regen + --check, exit 1 on mismatch
+    # refresh the committed record after a DELIBERATE spec change:
+    python scripts/dataset_fingerprint.py --update
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ARTIFACT = os.path.join(REPO, "artifacts",
+                                "dataset_fingerprint.json")
+
+#: Fingerprint record format version.
+FINGERPRINT_SCHEMA = 1
+
+
+def h5_content_sha256(path: str) -> str:
+    """Content hash of every dataset in an h5 file, sorted by name —
+    stable across regeneration (HDF5 header mtimes excluded by
+    construction)."""
+    import h5py
+
+    h = hashlib.sha256()
+    with h5py.File(path, "r") as f:
+        names: list = []
+        f.visititems(lambda name, obj: names.append(name)
+                     if isinstance(obj, h5py.Dataset) else None)
+        for name in sorted(names):
+            ds = f[name]
+            h.update(name.encode("utf-8"))
+            h.update(repr(tuple(ds.shape)).encode())
+            h.update(str(ds.dtype).encode())
+            h.update(ds[()].tobytes())
+    return h.hexdigest()
+
+
+def json_content_sha256(path: str) -> str:
+    """Canonical-JSON hash: key order and whitespace cannot perturb it."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    canon = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def fingerprint_paths(paths: dict) -> dict:
+    """Per-split {label_h5, vocab_json} content hashes + one combined
+    digest (the headline the Makefile prints)."""
+    out: dict = {"schema": FINGERPRINT_SCHEMA, "splits": {}}
+    combined = hashlib.sha256()
+    for split in sorted(paths):
+        p = paths[split]
+        rec = {"label_h5": h5_content_sha256(p["label_h5"]),
+               "vocab_json": json_content_sha256(p["vocab_json"])}
+        out["splits"][split] = rec
+        combined.update(split.encode())
+        combined.update(rec["label_h5"].encode())
+        combined.update(rec["vocab_json"].encode())
+    out["combined"] = combined.hexdigest()
+    return out
+
+
+def regenerate(root: str, *, num_videos: int, num_val: int,
+               feat_dims, feat_times, rich_vocab: int,
+               labels_only: bool) -> dict:
+    """The chain's own recipe (scripts/scale_chain.generate_data) —
+    never a private reimplementation that could drift.  With
+    ``labels_only`` the expensive feature h5s are skipped (they are not
+    part of the fingerprint identity)."""
+    from scale_chain import generate_data
+
+    if labels_only:
+        # The feature synthesis step reads the label plane back, so
+        # skipping it is a pure suffix cut: patch generate() to stop
+        # after build_split + vocab.
+        import cst_captioning_tpu.data.synthetic as synthetic
+
+        real_write = synthetic._write_features
+
+        def skip(*a, **kw):
+            return []  # keeps paths["feat_h5"] a valid (empty) path list
+
+        synthetic._write_features = skip
+        try:
+            return generate_data(root, num_videos, num_val,
+                                 feat_dims=tuple(feat_dims),
+                                 feat_times=tuple(feat_times),
+                                 rich_vocab=rich_vocab)
+        finally:
+            synthetic._write_features = real_write
+    return generate_data(root, num_videos, num_val,
+                         feat_dims=tuple(feat_dims),
+                         feat_times=tuple(feat_times),
+                         rich_vocab=rich_vocab)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="regenerate + content-fingerprint the north-star "
+                    "dataset (make dataset-regen)")
+    p.add_argument("--out_dir", default=None,
+                   help="regenerate here (default: a fresh temp dir — "
+                        "the post-wipe-rebuild proof; pass "
+                        "/tmp/cst_scale/data to also leave the chain's "
+                        "dataset in place)")
+    p.add_argument("--artifact", default=DEFAULT_ARTIFACT,
+                   help="the committed fingerprint record")
+    p.add_argument("--num_videos", type=int, default=6513)
+    p.add_argument("--num_val", type=int, default=497)
+    p.add_argument("--feat_dims", type=int, nargs="+",
+                   default=[2048, 4096])
+    p.add_argument("--feat_times", type=int, nargs="+", default=[28, 1])
+    p.add_argument("--rich_vocab", type=int, default=8000)
+    p.add_argument("--labels_only", type=int, default=1,
+                   help="1 (default) = skip feature-h5 synthesis; the "
+                        "fingerprint covers label h5 + vocab only")
+    p.add_argument("--update", action="store_true",
+                   help="write the artifact instead of checking it")
+    p.add_argument("--check", action="store_true",
+                   help="compare against the artifact; exit 1 on "
+                        "mismatch (the default when the artifact "
+                        "exists)")
+    args = p.parse_args(argv)
+
+    root = args.out_dir or tempfile.mkdtemp(prefix="cst_dataset_fp_")
+    os.makedirs(root, exist_ok=True)
+    paths = regenerate(root, num_videos=args.num_videos,
+                       num_val=args.num_val, feat_dims=args.feat_dims,
+                       feat_times=args.feat_times,
+                       rich_vocab=args.rich_vocab,
+                       labels_only=bool(args.labels_only))
+    fp = fingerprint_paths(paths)
+    fp["spec"] = {"num_videos": args.num_videos,
+                  "num_val": args.num_val,
+                  "feat_dims": list(args.feat_dims),
+                  "feat_times": list(args.feat_times),
+                  "rich_vocab": args.rich_vocab}
+    print(json.dumps({"combined": fp["combined"], "root": root}))
+
+    if args.update:
+        from cst_captioning_tpu.resilience.integrity import atomic_json_write
+
+        os.makedirs(os.path.dirname(args.artifact), exist_ok=True)
+        atomic_json_write(args.artifact, fp, indent=2, sort_keys=True)
+        print(f"dataset_fingerprint: wrote {args.artifact}")
+        return 0
+
+    if args.check or os.path.exists(args.artifact):
+        if not os.path.exists(args.artifact):
+            print(f"dataset_fingerprint: no committed artifact at "
+                  f"{args.artifact} (run --update first)",
+                  file=sys.stderr)
+            return 1
+        with open(args.artifact) as f:
+            want = json.load(f)
+        if want.get("spec") != fp["spec"]:
+            print("dataset_fingerprint: spec differs from the "
+                  "committed record — comparing apples to oranges:\n"
+                  f"  committed: {want.get('spec')}\n"
+                  f"  this run:  {fp['spec']}", file=sys.stderr)
+            return 1
+        if want.get("combined") != fp["combined"]:
+            for split, rec in fp["splits"].items():
+                was = (want.get("splits") or {}).get(split) or {}
+                for key, got in rec.items():
+                    if was.get(key) != got:
+                        print(f"dataset_fingerprint: {split}/{key} "
+                              f"mismatch: committed {was.get(key)}, "
+                              f"regenerated {got}", file=sys.stderr)
+            return 1
+        print("dataset_fingerprint: regeneration IDENTICAL to the "
+              "committed record")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

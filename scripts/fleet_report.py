@@ -24,7 +24,20 @@ hide a broken plane; each failure is one ``!!`` stderr line + exit 1):
 - **coverage hole** — a sample is missing replica-slot rows (fewer
   child rows than the fleet's replica count).
 
-See OBSERVABILITY.md "Fleet plane".
+Autoscale gates (active only when the run carried autoscaler data —
+``fleet.autoscale`` in any sample — so fixed-size runs are untouched):
+
+- **scale-event loss** — the active-replica count changed during the
+  run but the final sample still shows outstanding or parked work:
+  a scale event stranded requests;
+- **thrash** — more than ``--max_scale_changes`` (default 4) replica-
+  count changes: the autoscaler is flapping instead of converging;
+- **brownout p99 breach** — a sample taken while a brownout rung was
+  engaged shows fleet p99 above the SLO p99 target (or
+  ``--brownout_p99_ms``): shedding failed to protect admitted work.
+
+See OBSERVABILITY.md "Fleet plane" and SERVING.md "Autoscaling &
+brownout".
 """
 
 from __future__ import annotations
@@ -100,7 +113,32 @@ def _per_child(samples: list) -> dict:
     return acc
 
 
-def check_gates(samples: list, blackout_factor: float) -> list:
+def replica_timeline(samples: list) -> list:
+    """Run-length-compressed active-replica counts over the sample
+    series, e.g. ``[1, 3, 1]`` for a burst that scaled 1→3→1.  Prefers
+    ``fleet.active`` (excludes retired slots; written since the
+    autoscaler landed) and falls back to ``fleet.replicas`` for old
+    records, where the slot count never changes."""
+    counts = []
+    for s in samples:
+        fleet = s.get("fleet") or {}
+        n = fleet.get("active", fleet.get("replicas"))
+        if n is None:
+            continue
+        if not counts or counts[-1] != int(n):
+            counts.append(int(n))
+    return counts
+
+
+def _autoscale_samples(samples: list) -> list:
+    """The samples stamped by an armed autoscaler (fleet.autoscale)."""
+    return [s for s in samples
+            if (s.get("fleet") or {}).get("autoscale")]
+
+
+def check_gates(samples: list, blackout_factor: float,
+                max_scale_changes: int = 4,
+                brownout_p99_ms: float = None) -> list:
     """-> list of '!!' gate messages (empty = healthy)."""
     gates = []
     firing = sorted({name for s in samples
@@ -135,6 +173,47 @@ def check_gates(samples: list, blackout_factor: float) -> list:
                 f"{replicas} replica slot(s) — the zero-gap contract "
                 "(one row per slot per sample) is broken")
             break
+    # Autoscale gates: only judge runs that actually carried autoscaler
+    # data, so fixed-size fleets (and every pre-autoscaler record) keep
+    # their existing verdicts bit-for-bit.
+    scaled = _autoscale_samples(samples)
+    timeline = replica_timeline(samples)
+    changes = max(0, len(timeline) - 1)
+    if changes > 0:
+        final = (samples[-1].get("fleet") or {})
+        outstanding = int(final.get("outstanding") or 0)
+        parked = int(final.get("parked") or 0)
+        if outstanding or parked:
+            gates.append(
+                f"scale-event loss: replica count changed {changes} "
+                f"time(s) but the final sample still shows "
+                f"{outstanding} outstanding + {parked} parked "
+                "request(s) — a scale event stranded work")
+    if scaled and changes > max_scale_changes:
+        gates.append(
+            f"autoscaler thrash: {changes} replica-count change(s) "
+            f"(> {max_scale_changes}) — flapping instead of "
+            "converging (timeline "
+            f"{'->'.join(str(n) for n in timeline)})")
+    worst_brownout = None
+    for s in scaled:
+        fleet = s.get("fleet") or {}
+        if int((fleet.get("autoscale") or {}).get("rung") or 0) <= 0:
+            continue
+        p99 = fleet.get("latency_p99_ms")
+        target = brownout_p99_ms
+        if target is None:
+            target = (((s.get("slo") or {}).get("objectives") or {})
+                      .get("p99") or {}).get("target")
+        if p99 is not None and target is not None \
+                and float(p99) > float(target) \
+                and (worst_brownout is None or float(p99) > worst_brownout):
+            worst_brownout = float(p99)
+    if worst_brownout is not None:
+        gates.append(
+            f"brownout p99 breach: fleet p99 reached "
+            f"{worst_brownout:,.0f} ms while a brownout rung was "
+            "engaged — shedding failed to protect admitted work")
     return gates
 
 
@@ -150,6 +229,13 @@ def main(argv=None) -> int:
     p.add_argument("--blackout_factor", type=float, default=3.0,
                    help="scrape-gap gate threshold, in multiples of the "
                         "stamped scrape interval (default 3)")
+    p.add_argument("--max_scale_changes", type=int, default=4,
+                   help="autoscaler thrash gate: more replica-count "
+                        "changes than this fails the report (default 4; "
+                        "a clean burst drill is up+down = 2)")
+    p.add_argument("--brownout_p99_ms", type=float, default=None,
+                   help="brownout gate p99 ceiling in ms (default: the "
+                        "run's own SLO p99 objective target)")
     p.add_argument("--json", default=None,
                    help="also write the summary as JSON here (atomic)")
     args = p.parse_args(argv)
@@ -175,6 +261,23 @@ def main(argv=None) -> int:
          f"{fmt(fleet.get('latency_p50_ms'), ' ms')} / "
          f"{fmt(fleet.get('latency_p99_ms'), ' ms')}"),
     ]
+    timeline = replica_timeline(samples)
+    if timeline:
+        rows.append(
+            ("replica timeline",
+             f"{'->'.join(str(n) for n in timeline)} "
+             f"({max(0, len(timeline) - 1)} change(s))"))
+    autoscale = fleet.get("autoscale") or {}
+    if autoscale.get("enabled"):
+        rows.append(
+            ("autoscale",
+             f"bounds {fmt(autoscale.get('min'))}-"
+             f"{fmt(autoscale.get('max'))}, "
+             f"{fmt(autoscale.get('scale_ups'))} up / "
+             f"{fmt(autoscale.get('scale_downs'))} down, "
+             f"brownout rung {fmt(autoscale.get('rung'))} "
+             f"(entered {fmt(autoscale.get('brownout_entries'))}x), "
+             f"{fmt(autoscale.get('decisions'))} decision(s)"))
     if slo.get("enabled"):
         for name, obj in (slo.get("objectives") or {}).items():
             rows.append(
@@ -216,7 +319,9 @@ def main(argv=None) -> int:
     for k, v in rows:
         print(f"  {k:<{width}}  {v}")
 
-    gates = check_gates(samples, args.blackout_factor)
+    gates = check_gates(samples, args.blackout_factor,
+                        max_scale_changes=args.max_scale_changes,
+                        brownout_p99_ms=args.brownout_p99_ms)
     for msg in gates:
         print(f"  !! {msg}", file=sys.stderr)
     if args.json:
@@ -224,7 +329,8 @@ def main(argv=None) -> int:
 
         atomic_json_write(args.json, {
             "samples": len(samples), "span_s": span_s,
-            "fleet": fleet, "slo": slo, "gates": gates}, indent=2)
+            "fleet": fleet, "slo": slo,
+            "replica_timeline": timeline, "gates": gates}, indent=2)
     return 1 if gates else 0
 
 

@@ -170,6 +170,29 @@ def main(argv=None) -> int:
                  f"{fmt(pr.get('restarts'))} restart(s) / "
                  f"{fmt(pr.get('kills'))} kill(s), "
                  f"last_rc {fmt(pr.get('last_rc'))}"))
+    autoscale = rec.get("autoscale") or {}
+    if autoscale.get("enabled"):
+        rows += [
+            ("autoscale",
+             f"bounds {fmt(autoscale.get('min'))}-"
+             f"{fmt(autoscale.get('max'))} — "
+             f"{fmt(autoscale.get('scale_ups'))} up / "
+             f"{fmt(autoscale.get('scale_downs'))} down "
+             f"({fmt(autoscale.get('replica_changes'))} change(s), "
+             f"{fmt(autoscale.get('decisions'))} decision(s), "
+             f"no_thrash={autoscale.get('no_thrash')})"),
+            ("autoscale drill",
+             f"started_at_min={autoscale.get('started_at_min')}, "
+             f"scaled_up={autoscale.get('scaled_up')} (in "
+             f"{fmt(autoscale.get('scale_up_intervals'))} of "
+             f"{fmt(autoscale.get('scale_up_budget_intervals'))} scrape "
+             "interval(s)), "
+             f"scaled_down={autoscale.get('scaled_down')}, "
+             f"answered_ok={autoscale.get('answered_ok')}"),
+            ("brownout",
+             f"rung {fmt(autoscale.get('rung'))} at probe end, "
+             f"{fmt(autoscale.get('brownout_entries'))} entr(ies)"),
+        ]
     slo = rec.get("slo") or {}
     if slo.get("enabled"):
         firing = slo.get("firing") or []
@@ -276,6 +299,36 @@ def main(argv=None) -> int:
               "losing capacity it should have kept (SERVING.md "
               "'Process fleet')", file=sys.stderr)
         rc = 1
+    if autoscale.get("enabled"):
+        if autoscale.get("started_at_min") is False:
+            print("  !! the autoscaled fleet did not start at "
+                  "--autoscale_min replicas: the probe began over- or "
+                  "under-provisioned (SERVING.md 'Autoscaling & "
+                  "brownout')", file=sys.stderr)
+            rc = 1
+        if autoscale.get("scaled_up") is False:
+            print("  !! the burst never triggered a scale-up within the "
+                  "scrape-interval budget: the attribution signal path "
+                  "(queue_wait p99 rising, decode p99 flat) is broken "
+                  "(SERVING.md 'Autoscaling & brownout')", file=sys.stderr)
+            rc = 1
+        if autoscale.get("scaled_down") is False:
+            print("  !! the fleet never drained back to --autoscale_min "
+                  "after the burst: scale-down (quiet slow window + "
+                  "drain-based retire) is broken (SERVING.md "
+                  "'Autoscaling & brownout')", file=sys.stderr)
+            rc = 1
+        if autoscale.get("no_thrash") is False:
+            print("  !! the autoscaler flapped: more replica-count "
+                  "changes than a clean burst drill warrants — "
+                  "hysteresis/cooldowns are not holding (SERVING.md "
+                  "'Autoscaling & brownout')", file=sys.stderr)
+            rc = 1
+        if autoscale.get("answered_ok") is False:
+            print("  !! request(s) lost or double-answered across scale "
+                  "events: the drain/requeue discipline dropped work "
+                  "(SERVING.md 'Autoscaling & brownout')", file=sys.stderr)
+            rc = 1
     if stream.get("enabled") and stream.get("prefix_ok") is False:
         print("  !! streamed chunks are not prefix-consistent with the "
               "final captions (SERVING.md 'Streaming & result cache')",

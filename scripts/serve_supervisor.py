@@ -130,18 +130,43 @@ def make_launcher(opt, root: str, plan=None):
     return launcher
 
 
+def build_autoscaler(opt, root: str, fleet_obs, *, registry=None,
+                     lifecycle=None):
+    """The attribution-driven autoscaler (serving/autoscale.py, ISSUE
+    19) — armed by ``--autoscale_max > 0``, disarmed (None) otherwise.
+    The decisions log lands next to fleet_metrics.jsonl so
+    collect_evidence bundles them together."""
+    if getattr(opt, "autoscale_max", 0) <= 0:
+        return None
+    from cst_captioning_tpu.serving.autoscale import Autoscaler
+
+    hi = float(opt.autoscale_queue_hi_ms)
+    return Autoscaler(
+        fleet_obs,
+        min_replicas=opt.autoscale_min,
+        max_replicas=max(opt.autoscale_max, opt.autoscale_min),
+        queue_hi_ms=hi, queue_lo_ms=hi / 10.0,
+        up_cooldown_s=float(opt.autoscale_up_cooldown_s),
+        down_cooldown_s=float(opt.autoscale_down_cooldown_s),
+        out_dir=root, registry=registry, lifecycle=lifecycle)
+
+
 def build_supervisor(opt, root: str, *, plan=None, registry=None,
-                     lifecycle=None, fleet_obs=None):
+                     lifecycle=None, fleet_obs=None, autoscaler=None):
     from cst_captioning_tpu.serving.supervisor import ProcessFleetSupervisor
 
+    # An armed autoscaler owns the fleet size: boot at --autoscale_min
+    # and let the decisions log explain every change from there.
+    replicas = (opt.autoscale_min if autoscaler is not None
+                else opt.supervise_replicas)
     return ProcessFleetSupervisor(
-        make_launcher(opt, root, plan=plan), opt.supervise_replicas,
+        make_launcher(opt, root, plan=plan), replicas,
         restart_limit=opt.supervise_restart_limit,
         backoff_ms=opt.supervise_backoff_ms,
         wedge_timeout_s=opt.wedge_timeout,
         incident_dir=os.path.join(root, "incidents"),
         fault_plan=plan, registry=registry, lifecycle=lifecycle,
-        fleet_obs=fleet_obs)
+        fleet_obs=fleet_obs, autoscaler=autoscaler)
 
 
 def build_observability(opt, root: str, registry):
@@ -460,6 +485,287 @@ def run_probe(opt) -> int:
 
 
 # ---------------------------------------------------------------------------
+# the seeded 3-phase autoscale drill (--autoscale_probe 1)
+# ---------------------------------------------------------------------------
+
+
+def run_autoscale_probe(opt) -> int:
+    """The ISSUE 19 acceptance drill, machine-checked: idle -> 4x burst
+    -> idle through the real CLI.  The fleet boots at ``--autoscale_min``
+    children, must scale up within the scrape-interval budget once the
+    burst's queue_wait attribution burns, scale back down in the final
+    idle phase, answer EVERY request exactly once bit-identical to a
+    fault-free single-engine reference, and pay zero post-warmup
+    compiles on surviving children.  Prints the one-JSON-line record
+    scripts/serve_report.py renders and gates; the durable decisions
+    log + fleet_metrics.jsonl feed scripts/fleet_report.py's no-thrash
+    / no-loss / brownout gates."""
+    from cst_captioning_tpu.serving.supervisor import SupervisorUnrecoverable
+    from cst_captioning_tpu.telemetry.registry import MetricsRegistry
+
+    root = opt.supervise_dir or tempfile.mkdtemp(prefix="cst_autoscale_")
+    os.makedirs(root, exist_ok=True)
+    if opt.autoscale_max <= 0:
+        opt.autoscale_max = max(3, opt.autoscale_min + 1)
+    if not opt.serve_lifecycle:
+        # The decision signal IS the children's latency attribution —
+        # without their lifecycle plane there is nothing to scale on.
+        log.warning("autoscale probe: forcing --serve_lifecycle 1 "
+                    "(attribution is the autoscaler's input)")
+        opt.serve_lifecycle = 1
+    registry = MetricsRegistry()
+
+    idle_n = 3
+    video_ids: list = []
+    answers: dict = {}
+
+    tracer, lifecycle, fleet_obs = build_observability(opt, root, registry)
+    if lifecycle is not None:
+        lifecycle.attach(
+            counters=lambda: registry.snapshot().get("counters"))
+    autoscaler = build_autoscaler(opt, root, fleet_obs,
+                                  registry=registry, lifecycle=lifecycle)
+    sup = build_supervisor(opt, root, registry=registry,
+                           lifecycle=lifecycle, fleet_obs=fleet_obs,
+                           autoscaler=autoscaler)
+    scrape_s = opt.fleet_scrape_ms / 1000.0
+    rc = 0
+    try:
+        deadline = time.monotonic() + 120.0
+        while any(r.live and r.compiles0 is None for r in sup._replicas):
+            sup.tick()
+            if time.monotonic() > deadline:
+                raise RuntimeError("children never answered health")
+            time.sleep(0.01)
+        assert sup.active_replicas() == opt.autoscale_min, (
+            "fleet must START at --autoscale_min, got "
+            f"{sup.active_replicas()}")
+
+        def submit(i: int) -> None:
+            video_ids.append(f"v{i % 12}")
+            answers[i] = []
+            sup.submit(i, video_ids[i], respond=answers[i].append,
+                       stream=True)
+
+        def pump(until: float, stop=None) -> None:
+            while time.monotonic() < until:
+                if not sup.tick():
+                    time.sleep(0.005)
+                if stop is not None and stop():
+                    return
+
+        t0 = time.monotonic()
+        # Phase 1 — idle trickle: the fleet must NOT grow on this.
+        for i in range(idle_n):
+            submit(i)
+            pump(time.monotonic() + 2 * scrape_s)
+        base_after_idle = sup.active_replicas()
+
+        # Phase 2 — the 4x overload storm, open-loop at the fleet's
+        # edge: a fleet that is too small grows its QUEUE, not its
+        # arrival gaps, so keep ~4 replicas' worth of work standing in
+        # front of the --autoscale_min children however fast this
+        # machine's demo decode is.  The standing queue keeps the
+        # queue_wait attribution burning for full fast+slow windows —
+        # a sub-window blip is exactly what the damping must ignore.
+        backlog = max(8, 4 * opt.autoscale_min * 4)
+        # Scale-up budget: N scrape intervals (the acceptance bar) —
+        # generous wall-clock floor so slow CI child spawns don't flake
+        # the drill.
+        budget_intervals = 40
+        up_deadline = time.monotonic() + max(budget_intervals * scrape_s,
+                                             60.0)
+        i = idle_n
+        while time.monotonic() < up_deadline:
+            if sup.active_replicas() > opt.autoscale_min:
+                break
+            while sup.outstanding < backlog:
+                submit(i)
+                i += 1
+            if not sup.tick():
+                time.sleep(0.005)
+        scaled_up = sup.active_replicas() > opt.autoscale_min
+        up_intervals = (time.monotonic() - t0) / scrape_s
+
+        # Drain the storm completely (every request answered, however
+        # long the queue got).
+        deadline = time.monotonic() + 600.0
+        while sup.outstanding:
+            if not sup.tick():
+                time.sleep(0.005)
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"drill timed out with {sup.outstanding} of "
+                    f"{len(answers)} unanswered")
+
+        # Phase 3 — idle again: the extra replicas must drain out.
+        for _ in range(idle_n):
+            submit(i)
+            i += 1
+            pump(time.monotonic() + 2 * scrape_s)
+        num_requests = len(answers)
+        down_deadline = time.monotonic() + 120.0
+        pump(down_deadline,
+             stop=lambda: (sup.active_replicas() <= opt.autoscale_min
+                           and not sup.outstanding))
+        while sup.outstanding:
+            if not sup.tick():
+                time.sleep(0.005)
+            if time.monotonic() > deadline:
+                raise RuntimeError("phase-3 requests unanswered")
+        scaled_down = sup.active_replicas() <= opt.autoscale_min
+        makespan = time.monotonic() - t0
+
+        # Post-drill: zero post-warmup compiles on SURVIVING children.
+        for k in range(len(sup._replicas)):
+            if sup._replicas[k].live:
+                sup.request_stats(k)
+        settle = time.monotonic() + 30.0
+        while time.monotonic() < settle and any(
+                r.live and r.last_stats is None for r in sup._replicas):
+            sup.tick()
+            time.sleep(0.01)
+        recompiles = 0
+        for rep in sup._replicas:
+            if not rep.live or rep.compiles0 is None:
+                continue
+            now_c = (rep.last_stats or rep.health or {}).get("compiles")
+            if now_c is not None:
+                recompiles += max(0, int(now_c) - int(rep.compiles0))
+
+        finals = {}
+        completed = 0
+        prefix_ok = True
+        for i in range(num_requests):
+            terminal = [a for a in answers[i]
+                        if a.get("final") or "error" in a]
+            assert len(terminal) == 1, (
+                f"request {i} got {len(terminal)} terminals: "
+                f"{answers[i]}")
+            fin = terminal[0]
+            if "caption" in fin:
+                completed += 1
+                finals[i] = fin["caption"]
+                chunks = [a for a in answers[i]
+                          if a.get("stream") and not a.get("final")]
+                seqs = [c["seq"] for c in chunks]
+                text = " ".join(c["text"] for c in chunks
+                                if c["text"]).strip()
+                if seqs != list(range(len(seqs))) \
+                        or text != fin["caption"]:
+                    prefix_ok = False
+
+        reference = _single_engine_reference(
+            opt, root, sorted(set(video_ids)))
+        mismatches = sum(
+            1 for i, cap in finals.items()
+            if reference.get(video_ids[i]) != cap)
+        parity_ok = (completed == num_requests and mismatches == 0)
+
+        stats = sup.stats()
+        c = stats["supervisor"]
+        asc = stats.get("autoscale") or {}
+        budget_ok = c["sup_replica_deaths"] == 0
+        slo_status = fleet_obs.slo_status()
+        slo_ok = not slo_status.get("firing")
+        lat = [stats.get("latency_p50_ms"), stats.get("latency_p99_ms")]
+        # No-thrash at the source: the replica count changed exactly
+        # twice (one up, one down) in a clean run; <= 4 tolerates one
+        # extra round trip without calling the drill dead.
+        changes = (asc.get("scale_ups", 0) + asc.get("scale_downs", 0))
+        no_thrash = changes <= 4
+
+        record = {
+            "metric": SERVE_METRIC, "schema": 1,
+            "value": round(completed / makespan, 2) if makespan else None,
+            "platform": "cpu" if os.environ.get(
+                "JAX_PLATFORMS") == "cpu" else "supervised",
+            "completed": completed, "num_requests": num_requests,
+            "shed": c["sup_shed"], "makespan_s": round(makespan, 3),
+            "latency_p50_ms": lat[0], "latency_p99_ms": lat[1],
+            "beam_size": opt.beam_size,
+            "decode_chunk": getattr(opt, "decode_chunk", 8),
+            "buckets": opt.serve_buckets,
+            "recompiles_after_warmup": recompiles,
+            "stream": {"enabled": True, "prefix_ok": prefix_ok},
+            "slo": {"enabled": slo_status.get("enabled", False),
+                    "firing": slo_status.get("firing", []),
+                    "alerts_fired": slo_status.get("alerts_fired", 0),
+                    "alerts_cleared": slo_status.get("alerts_cleared", 0),
+                    "ok": slo_ok},
+            "fleet_obs": {
+                "samples": len(fleet_obs.series()),
+                "metrics_file": fleet_obs.metrics_path,
+                "trace_dir": os.path.join(root, "trace"),
+            },
+            "supervisor": {
+                "enabled": True,
+                "replicas": len(sup._replicas),
+                "restart_limit": opt.supervise_restart_limit,
+                "killed_replica": None,
+                "restarts": c["sup_replica_restarts"],
+                "requeued": c["sup_requeued"],
+                "deaths": c["sup_replica_deaths"],
+                "wedge_kills": c["sup_wedge_kills"],
+                "budget_ok": budget_ok,
+                "parity_ok": parity_ok,
+                "parity_mismatches": mismatches,
+                "incidents": len(stats["incidents"]),
+                "blackbox_harvested": True,
+                "per_replica": stats["per_replica"],
+            },
+            "autoscale": {
+                "enabled": True,
+                "min": opt.autoscale_min, "max": opt.autoscale_max,
+                "started_at_min": base_after_idle == opt.autoscale_min,
+                "scaled_up": scaled_up,
+                "scale_up_intervals": round(up_intervals, 1),
+                "scale_up_budget_intervals": budget_intervals,
+                "scaled_down": scaled_down,
+                "scale_ups": asc.get("scale_ups", 0),
+                "scale_downs": asc.get("scale_downs", 0),
+                "replica_changes": changes,
+                "no_thrash": no_thrash,
+                "brownout_entries": asc.get("brownout_entries", 0),
+                "rung": asc.get("rung", 0),
+                "decisions": asc.get("decisions", 0),
+                "decisions_file": autoscaler.decisions_path,
+                "answered_ok": completed == num_requests,
+            },
+        }
+        print(json.dumps(record))
+        report = {
+            "answered": completed == num_requests,
+            "parity_ok": parity_ok, "prefix_ok": prefix_ok,
+            "recompiles": recompiles, "budget_ok": budget_ok,
+            "started_at_min": base_after_idle == opt.autoscale_min,
+            "scaled_up": scaled_up, "scaled_down": scaled_down,
+            "no_thrash": no_thrash,
+        }
+        print(f"serve_supervisor: autoscale probe {json.dumps(report)}",
+              file=sys.stderr)
+        if not all([report["answered"], parity_ok, prefix_ok,
+                    recompiles == 0, budget_ok,
+                    report["started_at_min"], scaled_up, scaled_down,
+                    no_thrash]):
+            rc = 1
+    except SupervisorUnrecoverable as e:
+        from cst_captioning_tpu.resilience.exitcodes import (EXIT_WEDGE,
+                                                             describe)
+
+        print(f"serve_supervisor: UNRECOVERABLE: {e}; exiting "
+              f"{EXIT_WEDGE} ({describe(EXIT_WEDGE)})", file=sys.stderr)
+        rc = EXIT_WEDGE
+    finally:
+        sup.shutdown()
+        close_observability(tracer, fleet_obs)
+        write_supervisor_exit(root, rc, sup, registry)
+        print("serve_supervisor: " + json.dumps(sup.supervisor_counters()),
+              file=sys.stderr)
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # serving mode
 # ---------------------------------------------------------------------------
 
@@ -488,8 +794,11 @@ def run_serving(opt) -> int:
     # the merged fleet trace, the metrics scraper and the SLO monitor.
     tracer, lifecycle, fleet_obs = build_observability(opt, root, registry)
 
+    autoscaler = build_autoscaler(opt, root, fleet_obs,
+                                  registry=registry, lifecycle=lifecycle)
     sup = build_supervisor(opt, root, plan=plan, registry=registry,
-                           lifecycle=lifecycle, fleet_obs=fleet_obs)
+                           lifecycle=lifecycle, fleet_obs=fleet_obs,
+                           autoscaler=autoscaler)
     blackbox = (os.path.join(root, "blackbox.json")
                 if opt.serve_blackbox else None)
     server = SupervisorServer(sup, handler=handler, registry=registry,
@@ -570,6 +879,8 @@ def main(argv=None) -> int:
               "--test_feat_h5/--test_label_h5/--test_info_json (or pass "
               "--serve_demo 1)", file=sys.stderr)
         return 2
+    if getattr(opt, "autoscale_probe", 0):
+        return run_autoscale_probe(opt)
     if opt.supervise_probe:
         return run_probe(opt)
     return run_serving(opt)

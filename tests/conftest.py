@@ -67,6 +67,16 @@ os.environ["CST_SLO_P99_MS"] = ""
 os.environ["CST_SLO_AVAILABILITY"] = ""
 os.environ["CST_SLO_ERROR_RATE"] = ""
 
+# Autoscaler env knobs (ISSUE 19): an operator's exported fleet bounds
+# or cooldowns (opts.py resolves CST_AUTOSCALE_* as argparse defaults)
+# must not change what the suite pins.  '' falls back to the built-in
+# defaults; autoscale tests pass explicit values instead.
+os.environ["CST_AUTOSCALE_MIN"] = ""
+os.environ["CST_AUTOSCALE_MAX"] = ""
+os.environ["CST_AUTOSCALE_QUEUE_HI_MS"] = ""
+os.environ["CST_AUTOSCALE_UP_COOLDOWN_S"] = ""
+os.environ["CST_AUTOSCALE_DOWN_COOLDOWN_S"] = ""
+
 # Data-plane env knobs (ISSUE 15): an operator's exported worker count or
 # shard assignment (opts.py resolves CST_LOADER_WORKERS/CST_DATA_SHARDS/
 # CST_DATA_SHARD_ID as argparse defaults) must not change what the suite

@@ -1,0 +1,1035 @@
+"""Attribution-driven autoscaler + overload brownout (ISSUE 19,
+SERVING.md "Autoscaling & brownout").
+
+Fast in-process slice (tier-1, sanitizer-armed like test_supervisor):
+
+- the decision engine against a scripted attribution feed — burst
+  scales up within the fast window, a full quiet slow window scales
+  down, hysteresis/cooldowns/bounds hold, flapping is damped, decode-
+  driven latency does NOT scale, the idle-child ring-cumulative
+  correction, the brownout ladder escalates/de-escalates on patience;
+- the supervisor's grow/shrink surface with FakeChild fleets —
+  add_replica spawns warm, retire_worst drains to ``retired`` with no
+  incident and no restart, SIGKILL mid-retire falls through the
+  crash-requeue path exactly-once, candidates exclude retiring slots;
+- the brownout shed sites (deadline / parked / stream), each typed;
+- the durable decisions log, counters, lifecycle events, opts flags +
+  env fallbacks, arrival-shape generators, report gates, the
+  durable-rename satellite, and the doc pins.
+
+The real-subprocess burst drill through ``scripts/serve_supervisor.py
+--autoscale_probe`` is marked ``slow`` and runs via
+``make autoscale-chaos``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.resilience.exitcodes import EXIT_SIGKILL
+from cst_captioning_tpu.serving.autoscale import (
+    AUTOSCALE_COUNTERS,
+    AUTOSCALE_SCHEMA,
+    BROWNOUT_RUNGS,
+    Autoscaler,
+)
+from cst_captioning_tpu.serving.bench import (
+    burst_arrivals,
+    diurnal_arrivals,
+    make_arrivals,
+    replay_arrivals,
+)
+
+from test_supervisor import (  # the shared process-fleet fakes
+    FakeChild,
+    FakeClock,
+    build_sup,
+    child_of,
+    tick_until,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """Sanitizer-armed like the supervisor suite: the autoscale state
+    lock is exercised against the declared LOCK_ORDER in every test."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt: {receipt.read_text()}")
+
+
+# -- scripted decision-engine fixtures --------------------------------------
+
+
+class SeriesObs:
+    """A scriptable stand-in for FleetObs.series(): push one scrape
+    sample per call, shaped like telemetry/fleetobs.py's rows."""
+
+    def __init__(self):
+        self._samples = []
+
+    def series(self):
+        return list(self._samples)
+
+    def push(self, qw=0.0, dc=5.0, *, busy=True, settled=True,
+             firing=False):
+        self._samples.append({
+            "seq": len(self._samples) + 1,
+            "children": [{
+                "index": 0, "state": "ok" if settled else "backoff",
+                "live": True, "retiring": False,
+                "inflight": 1 if busy else 0,
+                "queue_depth": 1 if busy else 0,
+                "attribution_p99_ms": {"queue_wait": qw, "decode": dc},
+            }],
+            "slo": {"firing": ["p99"] if firing else []},
+        })
+
+
+class CountSup:
+    """Duck-typed supervisor: the autoscaler only needs the grow/shrink
+    verbs and the active count."""
+
+    def __init__(self, n=1):
+        self.n = n
+        self.adds = 0
+        self.retires = 0
+
+    def active_replicas(self):
+        return self.n
+
+    def add_replica(self):
+        self.n += 1
+        self.adds += 1
+        return self.n - 1
+
+    def retire_worst(self):
+        self.n -= 1
+        self.retires += 1
+        return self.n
+
+
+def mk_scaler(tmp_path=None, **kw):
+    obs = SeriesObs()
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("queue_hi_ms", 50.0)
+    kw.setdefault("queue_lo_ms", 5.0)
+    kw.setdefault("fast_samples", 3)
+    kw.setdefault("slow_samples", 9)
+    kw.setdefault("up_cooldown_s", 0.0)
+    kw.setdefault("down_cooldown_s", 0.0)
+    if tmp_path is not None:
+        kw.setdefault("out_dir", str(tmp_path))
+    return Autoscaler(obs, **kw), obs
+
+
+# -- the decision engine ----------------------------------------------------
+
+
+def test_bounds_and_hysteresis_validated():
+    obs = SeriesObs()
+    with pytest.raises(ValueError):
+        Autoscaler(obs, min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(obs, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(obs, queue_hi_ms=10.0, queue_lo_ms=10.0)
+
+
+def test_burst_scales_up_within_the_fast_window(tmp_path):
+    asc, obs = mk_scaler(tmp_path)
+    sup = CountSup(1)
+    for _ in range(3):          # exactly the fast window
+        obs.push(qw=500.0)
+    asc.tick(sup, now=1.0)
+    assert sup.adds == 1 and sup.n == 2
+    c = asc.counters()
+    assert c["autoscale_scale_ups"] == 1 and c["autoscale_ticks"] == 3
+    # One durable decision line, schema-stamped, with the attribution
+    # evidence it acted on.
+    lines = [json.loads(l) for l in
+             open(tmp_path / "autoscale_decisions.jsonl")]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["schema"] == AUTOSCALE_SCHEMA
+    assert rec["kind"] == "autoscale_decision"
+    assert rec["action"] == "scale_up" and rec["seq"] == 1
+    assert rec["replicas_before"] == 1 and rec["replicas_after"] == 2
+    assert rec["reason"]["queue_wait_fast_ms"] >= 50.0
+    assert rec["reason"]["decode_flat"] is True
+    assert rec["thresholds"]["queue_hi_ms"] == 50.0
+
+
+def test_up_cooldown_damps_consecutive_scale_ups():
+    asc, obs = mk_scaler(up_cooldown_s=10.0)
+    sup = CountSup(1)
+    for _ in range(3):
+        obs.push(qw=500.0)
+    asc.tick(sup, now=1.0)
+    assert sup.adds == 1
+    obs.push(qw=500.0)
+    asc.tick(sup, now=2.0)      # still burning, but inside the cooldown
+    assert sup.adds == 1
+    assert asc.counters()["autoscale_holds_cooldown"] == 1
+    obs.push(qw=500.0)
+    asc.tick(sup, now=12.0)     # cooldown expired
+    assert sup.adds == 2
+
+
+def test_decode_driven_latency_does_not_scale_up():
+    """queue_wait burning because DECODE got slower is not a capacity
+    problem: the fast-window decode p99 outgrowing the slow baseline
+    vetoes the scale-up."""
+    asc, obs = mk_scaler()
+    sup = CountSup(1)
+    for _ in range(6):
+        obs.push(qw=500.0, dc=1.0)
+    for _ in range(3):
+        obs.push(qw=500.0, dc=100.0)   # decode exploded in the fast window
+    asc.tick(sup, now=1.0)
+    assert sup.adds == 0 and not asc.decisions
+
+
+def test_full_quiet_slow_window_scales_down_and_reearns(tmp_path):
+    asc, obs = mk_scaler(tmp_path)
+    sup = CountSup(3)
+    for _ in range(9):          # the ENTIRE slow window quiet
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=1.0)
+    assert sup.retires == 1 and sup.n == 2
+    # The window was cleared: 3 more quiet samples are NOT yet a full
+    # slow window at the new size — no second retire.
+    for _ in range(3):
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=2.0)
+    assert sup.retires == 1
+    for _ in range(6):
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=3.0)
+    assert sup.retires == 2 and sup.n == 1
+    acts = [d["action"] for d in asc.decisions]
+    assert acts == ["scale_down", "scale_down"]
+
+
+def test_down_cooldown_and_min_bound_hold():
+    asc, obs = mk_scaler(down_cooldown_s=100.0, min_replicas=1)
+    sup = CountSup(3)
+    for _ in range(9):
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=1.0)
+    assert sup.retires == 1
+    for _ in range(9):
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=2.0)      # quiet again, but inside the cooldown
+    assert sup.retires == 1
+    assert asc.counters()["autoscale_holds_cooldown"] == 1
+    # At min, quiet holds on the bound instead.
+    asc2, obs2 = mk_scaler()
+    sup2 = CountSup(1)
+    for _ in range(9):
+        obs2.push(qw=0.0, busy=False)
+    asc2.tick(sup2, now=1.0)
+    assert sup2.retires == 0
+    assert asc2.counters()["autoscale_holds_bounds"] == 1
+
+
+def test_firing_slo_blocks_scale_down():
+    asc, obs = mk_scaler()
+    sup = CountSup(2)
+    for _ in range(9):
+        obs.push(qw=0.0, busy=False, firing=True)
+    asc.tick(sup, now=1.0)
+    assert sup.retires == 0 and not asc.decisions
+
+
+def test_hysteresis_band_makes_no_decision():
+    asc, obs = mk_scaler()      # lo=5 < 20 < hi=50
+    sup = CountSup(2)
+    for _ in range(9):
+        obs.push(qw=20.0)
+    asc.tick(sup, now=1.0)
+    assert sup.adds == 0 and sup.retires == 0 and not asc.decisions
+
+
+def test_idle_child_zeroes_ring_cumulative_queue_pressure():
+    """The scraped attribution p99 never decays after a burst (the ring
+    is cumulative); a child with NOTHING waiting must still read as
+    quiet or the fleet could never scale back down."""
+    asc, obs = mk_scaler()
+    sup = CountSup(2)
+    for _ in range(9):
+        obs.push(qw=5000.0, busy=False)   # stale burst p99, idle child
+    asc.tick(sup, now=1.0)
+    assert sup.retires == 1
+
+
+def test_unsettled_fleet_defers_decisions():
+    asc, obs = mk_scaler()
+    sup = CountSup(1)
+    for _ in range(3):
+        obs.push(qw=500.0, settled=False)  # a spawn/backoff in flight
+    asc.tick(sup, now=1.0)
+    assert sup.adds == 0
+
+
+def test_brownout_ladder_escalates_on_patience_and_deescalates(tmp_path):
+    asc, obs = mk_scaler(tmp_path, max_replicas=2, brownout_patience=2)
+    sup = CountSup(2)           # pinned at max
+    for _ in range(3):
+        obs.push(qw=500.0)
+    asc.tick(sup, now=1.0)      # sat 1: bound hold, no rung yet
+    assert asc.brownout_rung() == 0
+    assert asc.counters()["autoscale_holds_bounds"] == 1
+    t = 2.0
+    for want_rung in (1, 2, 3):
+        for _ in range(2):      # patience=2 burning evaluations per rung
+            obs.push(qw=500.0)
+            asc.tick(sup, now=t)
+            t += 1.0
+        assert asc.brownout_rung() == want_rung
+    # Capped at the last rung.
+    for _ in range(4):
+        obs.push(qw=500.0)
+        asc.tick(sup, now=t)
+        t += 1.0
+    assert asc.brownout_rung() == len(BROWNOUT_RUNGS)
+    # Sustained calm walks back down one rung per patience window —
+    # but "calm" means the FAST window stopped burning, so the burst
+    # samples must flush out of it first (3 calm pushes, 1 evaluation).
+    for _ in range(3):
+        obs.push(qw=20.0)       # hysteresis band: calm but not "down"
+    asc.tick(sup, now=t)        # calm evaluation #1
+    t += 1.0
+    for want_rung in (2, 2, 1, 1, 0, 0):
+        obs.push(qw=20.0)
+        asc.tick(sup, now=t)    # every 2nd calm evaluation de-escalates
+        t += 1.0
+        assert asc.brownout_rung() == want_rung
+    acts = [d["action"] for d in asc.decisions]
+    assert acts == ["brownout_enter"] * 3 + ["brownout_exit"] * 3
+    names = [d["rung_name"] for d in asc.decisions]
+    assert names == list(BROWNOUT_RUNGS) + list(reversed(BROWNOUT_RUNGS))
+    c = asc.counters()
+    assert c["brownout_entries"] == 3 and c["brownout_exits"] == 3
+    assert sup.adds == 0        # brownout replaced growth at the bound
+
+
+def test_flapping_traffic_yields_at_most_two_changes():
+    """The drill's no-thrash promise: a burst that keeps flickering on
+    and off inside the cooldowns produces one up and (after sustained
+    quiet) one down — not a change per flicker."""
+    asc, obs = mk_scaler(up_cooldown_s=30.0, down_cooldown_s=30.0)
+    sup = CountSup(1)
+    t = 1.0
+    for flick in range(6):      # 6 on/off flickers, 1s apart
+        for _ in range(3):
+            obs.push(qw=500.0 if flick % 2 == 0 else 0.0,
+                     busy=flick % 2 == 0)
+        asc.tick(sup, now=t)
+        t += 1.0
+    # Sustained quiet long after the cooldown.
+    for _ in range(9):
+        obs.push(qw=0.0, busy=False)
+    asc.tick(sup, now=t + 60.0)
+    changes = sup.adds + sup.retires
+    assert sup.adds == 1 and changes <= 2
+
+
+def test_note_shed_status_and_registry():
+    class Reg:
+        def __init__(self):
+            self.declared = []
+            self.counts = {}
+
+        def declare(self, *names):
+            self.declared += list(names)
+
+        def inc(self, name, n=1):
+            self.counts[name] = self.counts.get(name, 0) + n
+
+    reg = Reg()
+    asc = Autoscaler(SeriesObs(), registry=reg)
+    assert set(AUTOSCALE_COUNTERS) <= set(reg.declared)
+    asc.note_shed("deadline")
+    asc.note_shed("stream")
+    assert reg.counts["brownout_shed_deadline"] == 1
+    assert reg.counts["brownout_shed_stream"] == 1
+    st = asc.status()
+    assert st["enabled"] is True and st["rung"] == 0
+    assert st["min"] == 1 and st["max"] == 4
+    assert set(AUTOSCALE_COUNTERS) == set(st["counters"])
+
+
+def test_decisions_emit_valid_lifecycle_events():
+    from cst_captioning_tpu.telemetry.lifecycle import LifecycleTracer
+
+    clk = FakeClock(5.0)
+    lc = LifecycleTracer(clock=clk)
+    asc, obs = mk_scaler()
+    asc._lifecycle = lc
+    sup = CountSup(1)
+    for _ in range(3):
+        obs.push(qw=500.0)
+    asc.tick(sup, now=1.0)      # would raise on an unregistered kind
+    evs = [e for e in lc.events() if e["kind"] == "autoscale_decision"]
+    assert len(evs) == 1
+    assert evs[0]["id"] == "autoscale:1"
+    assert evs[0]["action"] == "scale_up"
+
+
+# -- the supervisor's grow/shrink surface -----------------------------------
+
+
+def test_add_replica_appends_and_spawns_a_warm_slot(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 1)
+    assert sup.active_replicas() == 1
+    ix = sup.add_replica()
+    assert ix == 1 and sup.active_replicas() == 2
+    assert len(children) == 2 and children[1].alive
+    assert sup.supervisor_counters()["sup_replicas_added"] == 1
+    # The new slot takes load immediately.
+    got = []
+    for i in range(4):
+        sup.submit(i, f"v{i}", respond=got.append)
+    assert len(children[0].jobs) == 2 and len(children[1].jobs) == 2
+
+
+def test_retire_worst_drains_to_retired_without_incident(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 2)
+    got = []
+    for i in range(4):
+        sup.submit(i, f"v{i}", respond=got.append)
+    ix = sup.retire_worst()
+    assert ix == 1              # tie on load -> highest index is worst
+    rep = sup._replicas[1]
+    assert rep.retiring and children[1].draining
+    # New work routes around the retiring slot.
+    sup.submit(9, "v9", respond=got.append)
+    assert len(children[0].jobs) == 3 and children[1].sent[-1:] != [9]
+    tick_until(sup, lambda: rep.state == "retired")
+    tick_until(sup, lambda: len(got) == 5)
+    # Every request answered with its real caption — the in-flight work
+    # FINISHED on the draining child, nothing was requeued by the
+    # scale-down itself.
+    by_id = {a["id"]: a for a in got}
+    assert sorted(by_id) == [0, 1, 2, 3, 9]
+    for i in range(4):
+        assert by_id[i]["caption"] == FakeChild.caption_for(f"v{i}")
+    c = sup.supervisor_counters()
+    assert c["sup_replicas_retired"] == 1
+    assert c["sup_requeued"] == 0 and c["sup_replica_restarts"] == 0
+    assert not sup._incidents   # a deliberate retire is not an incident
+    assert sup.active_replicas() == 1
+    # The retired slot never restarts.
+    for _ in range(8):
+        sup.tick()
+    assert sup._replicas[1].state == "retired"
+    assert sup._replicas[1].child is None
+
+
+def test_retire_worst_refuses_to_empty_the_fleet(tmp_path):
+    sup, children, _ = build_sup(tmp_path, 1)
+    assert sup.retire_worst() is None
+    sup2, children2, _ = build_sup(tmp_path / "b", 2)
+    children2[0].die(EXIT_SIGKILL)
+    sup2.tick()                 # one slot in backoff -> one candidate
+    assert sup2.retire_worst() is None
+
+
+def test_sigkill_mid_retire_requeues_exactly_once(tmp_path):
+    """A child murdered MID-drain falls through the ordinary crash
+    requeue: its in-flight work lands on a survivor, every id answered
+    exactly once, bit-identical captions, slot still ends retired."""
+    sup, children, _ = build_sup(tmp_path, 2)
+    got = []
+    sup.submit("a", "v1", respond=got.append)
+    sup.submit("b", "v2", respond=got.append)
+    ix = sup.retire_worst()
+    assert ix == 1
+    child_of(children, 1).kill()          # SIGKILL before the drain lands
+    tick_until(sup, lambda: len([a for a in got
+                                 if a.get("caption")]) == 2)
+    by_id = {}
+    for a in got:
+        by_id.setdefault(a["id"], []).append(a)
+    assert sorted(by_id) == ["a", "b"]
+    for rid, answers in by_id.items():
+        assert len(answers) == 1          # exactly once, never double
+    assert by_id["a"][0]["caption"] == FakeChild.caption_for("v1")
+    assert by_id["b"][0]["caption"] == FakeChild.caption_for("v2")
+    c = sup.supervisor_counters()
+    assert c["sup_requeued"] == 1
+    assert c["sup_replicas_retired"] == 1
+    assert sup._replicas[1].state == "retired"
+
+
+# -- the brownout shed sites ------------------------------------------------
+
+
+class StubScaler:
+    """Just the rung surface the supervisor's shed sites read."""
+
+    def __init__(self, rung=0, deadline_margin=4.0, parked_cap=0):
+        self.rung = rung
+        self.deadline_margin = deadline_margin
+        self.parked_cap = parked_cap
+        self.sheds = []
+
+    def brownout_rung(self):
+        return self.rung
+
+    def note_shed(self, rung):
+        self.sheds.append(rung)
+
+    def tick(self, sup, now):
+        pass
+
+    def status(self):
+        return {"enabled": True, "rung": self.rung}
+
+
+def test_rung1_tightens_deadline_admission(tmp_path):
+    """A deadline that clears the plain service floor but not the
+    brownout margin is shed with its own typed reason."""
+    scaler = StubScaler(rung=1, deadline_margin=4.0)
+    sup, children, _ = build_sup(
+        tmp_path, 2, autoscaler=scaler,
+        child_kw={k: {"min_service_ms": 100.0} for k in range(2)})
+    sup.tick()
+    sup.tick()                  # health floors in
+    got = []
+    # 150ms > 100ms floor (admit normally) but < 4x100ms margin.
+    sup.submit("a", "v1", respond=got.append, deadline_ms=150.0)
+    assert got[-1]["error"] == "expired"
+    assert got[-1]["why"] == "brownout_deadline"
+    assert scaler.sheds == ["deadline"]
+    assert not children[0].jobs and not children[1].jobs
+    # A comfortable deadline still admits under rung 1.
+    sup.submit("b", "v2", respond=got.append, deadline_ms=5000.0)
+    assert children[0].jobs or children[1].jobs
+
+
+def test_rung2_caps_parked_depth(tmp_path):
+    scaler = StubScaler(rung=2, parked_cap=0)
+    sup, children, clock = build_sup(tmp_path, 1, autoscaler=scaler)
+    children[0].die(EXIT_SIGKILL)
+    sup.tick()                  # no live replica: placement would park
+    got = []
+    sup.submit("a", "v2", respond=got.append, deadline_ms=5000.0)
+    assert got[-1]["error"] == "shed"
+    assert got[-1]["why"] == "brownout_parked"
+    assert scaler.sheds == ["parked"]
+    assert sup.supervisor_counters()["sup_parked"] == 0
+
+
+def test_rung3_rejects_new_stream_ops_only(tmp_path):
+    scaler = StubScaler(rung=3)
+    sup, children, _ = build_sup(tmp_path, 1, autoscaler=scaler)
+    got = []
+    sup.submit("s", "v1", respond=got.append, stream=True)
+    assert got[-1]["error"] == "shed"
+    assert got[-1]["why"] == "brownout_stream" and got[-1]["final"]
+    assert scaler.sheds == ["stream"]
+    # Plain requests still flow at rung 3.
+    sup.submit("p", "v2", respond=got.append)
+    tick_until(sup, lambda: any(a.get("caption") for a in got))
+    assert got[-1]["caption"] == FakeChild.caption_for("v2")
+
+
+def test_snapshot_and_stats_carry_autoscale_and_retiring(tmp_path):
+    scaler = StubScaler(rung=1)
+    sup, children, _ = build_sup(tmp_path, 2, autoscaler=scaler)
+    sup.retire_worst()
+    snap = sup.scrape_snapshot()
+    assert [c["retiring"] for c in snap["children"]] == [False, True]
+    assert snap["fleet"]["active"] == 2
+    assert snap["fleet"]["autoscale"]["enabled"] is True
+    assert sup.stats()["autoscale"]["rung"] == 1
+    # The health view never counts a retired slot's terminal state.
+    tick_until(sup, lambda: sup._replicas[1].state == "retired")
+    sup.tick()
+    assert sup.health_payload()["status"] == "ok"
+
+
+# -- the closed loop: Autoscaler driving a real FakeChild fleet -------------
+
+
+def test_closed_loop_burst_grows_then_quiet_drains(tmp_path):
+    """The in-process twin of the CLI drill: a scripted attribution
+    burst makes the autoscaler grow a REAL (FakeChild) supervisor, and
+    scripted quiet drains it back — at most one up and one down."""
+    obs = SeriesObs()
+    asc = Autoscaler(obs, min_replicas=1, max_replicas=3,
+                     fast_samples=3, slow_samples=9,
+                     up_cooldown_s=0.0, down_cooldown_s=0.0)
+    clock = FakeClock()
+    sup, children, _ = build_sup(tmp_path, 1, clock=clock,
+                                 autoscaler=asc)
+    for _ in range(3):
+        obs.push(qw=500.0)
+    sup.tick()                  # the supervisor tick runs asc.tick
+    assert sup.active_replicas() == 2 and len(children) == 2
+    got = []
+    sup.submit("x", "v3", respond=got.append)
+    for _ in range(9):
+        obs.push(qw=0.0, busy=False)
+    clock.advance(1.0)
+    sup.tick()
+    # The worst-ranked slot (the one holding the in-flight request) is
+    # draining out.
+    assert any(r.retiring or r.state == "retired"
+               for r in sup._replicas)
+    tick_until(sup, lambda: sup.active_replicas() == 1)
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v3")
+    assert [d["action"] for d in asc.decisions] == \
+        ["scale_up", "scale_down"]
+
+
+# -- arrival shapes ---------------------------------------------------------
+
+
+def test_arrival_shapes_deterministic_sorted_and_sized():
+    for shape in ("poisson", "diurnal", "burst"):
+        a = make_arrivals(shape, 64, 20.0, seed=3)
+        b = make_arrivals(shape, 64, 20.0, seed=3)
+        assert np.array_equal(a, b), shape
+        assert len(a) == 64 and a[0] >= 0.0
+        assert np.all(np.diff(a) >= 0.0), shape
+    assert not np.array_equal(make_arrivals("burst", 64, 20.0, seed=3),
+                              make_arrivals("burst", 64, 20.0, seed=4))
+
+
+def test_burst_arrivals_cluster_in_the_duty_window():
+    a = burst_arrivals(400, 10.0, seed=0, period_s=8.0, duty=0.25,
+                       burst_factor=4.0)
+    phase = np.mod(a, 8.0)
+    in_burst = np.mean(phase < 2.0)     # 25% of the period
+    # Expected mass in the window: 4x0.25 / (4x0.25 + 0.75) ~= 0.57,
+    # vs 0.25 if the shape were flat.
+    assert in_burst > 0.45
+
+
+def test_diurnal_arrivals_modulate_rate():
+    a = diurnal_arrivals(400, 10.0, seed=0, period_s=10.0, depth=0.9)
+    phase = np.mod(a, 10.0)
+    peak = np.mean((phase > 1.0) & (phase < 4.0))
+    trough = np.mean((phase > 6.0) & (phase < 9.0))
+    assert peak > trough                # sinusoid peak draws more
+
+
+def test_replay_arrivals_roundtrip_and_errors(tmp_path):
+    trace = tmp_path / "trace.jsonl"
+    ts = [0.5, 0.1, 0.9, 0.3]
+    trace.write_text("".join(json.dumps({"t": t}) + "\n" for t in ts))
+    a = replay_arrivals(str(trace), 4)
+    assert a[0] == 0.0                  # rebased to the first arrival
+    assert np.allclose(a, [0.0, 0.2, 0.4, 0.8])
+    with pytest.raises(ValueError):
+        replay_arrivals(str(trace), 5)  # fewer stamps than requests
+    with pytest.raises(ValueError):
+        make_arrivals("replay", 4, 10.0)  # no trace path
+    with pytest.raises(ValueError):
+        make_arrivals("sawtooth", 4, 10.0)
+
+
+# -- opts flags + env fallbacks ---------------------------------------------
+
+
+def test_autoscale_opts_defaults_and_env_fallbacks(monkeypatch):
+    from cst_captioning_tpu.opts import parse_opts
+
+    opt = parse_opts([])
+    assert opt.autoscale_min == 1 and opt.autoscale_max == 0  # disarmed
+    assert opt.autoscale_queue_hi_ms == 50
+    assert opt.autoscale_up_cooldown_s == 2
+    assert opt.autoscale_down_cooldown_s == 10
+    monkeypatch.setenv("CST_AUTOSCALE_MAX", "5")
+    monkeypatch.setenv("CST_AUTOSCALE_QUEUE_HI_MS", "80")
+    opt = parse_opts([])
+    assert opt.autoscale_max == 5 and opt.autoscale_queue_hi_ms == 80
+    # The flag beats the env.
+    opt = parse_opts(["--autoscale_max", "2"])
+    assert opt.autoscale_max == 2
+
+
+def test_autoscale_opts_validators_reject_nonsense():
+    from cst_captioning_tpu.opts import parse_opts
+
+    with pytest.raises(SystemExit):
+        parse_opts(["--autoscale_min", "0"])
+    with pytest.raises(SystemExit):
+        parse_opts(["--autoscale_max", "-1"])
+    with pytest.raises(SystemExit):
+        parse_opts(["--autoscale_queue_hi_ms", "0"])
+
+
+def test_build_autoscaler_arms_only_on_positive_max(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from serve_supervisor import build_autoscaler
+        from cst_captioning_tpu.opts import parse_opts
+    finally:
+        sys.path.pop(0)
+
+    opt = parse_opts([])
+    assert build_autoscaler(opt, str(tmp_path), SeriesObs()) is None
+    opt = parse_opts(["--autoscale_min", "2", "--autoscale_max", "4"])
+    asc = build_autoscaler(opt, str(tmp_path), SeriesObs())
+    assert asc.min_replicas == 2 and asc.max_replicas == 4
+    assert asc.queue_lo_ms < asc.queue_hi_ms
+    assert asc.decisions_path == os.path.join(
+        str(tmp_path), "autoscale_decisions.jsonl")
+    # max below min is coerced up, never a crash at the CLI edge.
+    opt = parse_opts(["--autoscale_min", "3", "--autoscale_max", "1"])
+    asc = build_autoscaler(opt, str(tmp_path), SeriesObs())
+    assert asc.max_replicas >= asc.min_replicas
+
+
+# -- report gates -----------------------------------------------------------
+
+
+def _mk_fleet_sample(seq, wall, *, active=2, outstanding=0, parked=0,
+                     rung=0, p99=9.0, autoscale=True, slo_target=50.0):
+    fleet = {"replicas": active, "in_service": active, "active": active,
+             "outstanding": outstanding, "parked": parked,
+             "completed": 5 * seq, "latency_p50_ms": 4.0,
+             "latency_p99_ms": p99}
+    if autoscale:
+        fleet["autoscale"] = {"enabled": True, "min": 1, "max": 3,
+                              "rung": rung, "scale_ups": 0,
+                              "scale_downs": 0, "brownout_entries": 0,
+                              "decisions": 0}
+    return {
+        "schema": 1, "kind": "fleet_sample", "seq": seq, "t": wall,
+        "wall": wall, "interval_ms": 1000.0, "fleet": fleet,
+        "children": [
+            {"index": k, "state": "ok", "live": True, "restarts": 0,
+             "inflight": 0, "queue_depth": 0, "latency_p50_ms": 4.0,
+             "latency_p99_ms": p99, "compiles": 2}
+            for k in range(active)],
+        "slo": {"enabled": True, "firing": [],
+                "objectives": {"p99": {"target": slo_target,
+                                       "fast_burn": 0.1,
+                                       "slow_burn": 0.1,
+                                       "firing": False}},
+                "alerts_fired": 0, "alerts_cleared": 0},
+    }
+
+
+def _run_fleet_report(tmp_path, samples, extra=()):
+    path = tmp_path / "fleet_metrics.jsonl"
+    with open(path, "w") as f:
+        for s in samples:
+            f.write(json.dumps(s) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "fleet_report.py"),
+         "--file", str(path), *extra],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_fleet_report_renders_replica_timeline_and_passes(tmp_path):
+    actives = [1, 1, 2, 2, 2, 1]
+    samples = [_mk_fleet_sample(k + 1, 100.0 + k, active=n)
+               for k, n in enumerate(actives)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 0, proc.stderr
+    assert "replica timeline" in proc.stdout
+    assert "1->2->1" in proc.stdout and "2 change(s)" in proc.stdout
+    assert "autoscale" in proc.stdout
+
+
+def test_fleet_report_gates_on_scale_event_loss(tmp_path):
+    samples = [_mk_fleet_sample(1, 100.0, active=1),
+               _mk_fleet_sample(2, 101.0, active=2),
+               _mk_fleet_sample(3, 102.0, active=1, outstanding=2)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "scale-event loss" in proc.stderr
+
+
+def test_fleet_report_gates_on_thrash(tmp_path):
+    actives = [1, 2, 1, 2, 1, 2, 1]      # 6 changes
+    samples = [_mk_fleet_sample(k + 1, 100.0 + k, active=n)
+               for k, n in enumerate(actives)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "thrash" in proc.stderr
+    # The budget is a flag.
+    proc = _run_fleet_report(tmp_path, samples,
+                             extra=("--max_scale_changes", "8"))
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_fleet_report_gates_on_brownout_p99_breach(tmp_path):
+    samples = [_mk_fleet_sample(1, 100.0),
+               _mk_fleet_sample(2, 101.0, rung=2, p99=90.0)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 1
+    assert "brownout p99 breach" in proc.stderr
+    # Held p99 under brownout passes.
+    samples = [_mk_fleet_sample(1, 100.0),
+               _mk_fleet_sample(2, 101.0, rung=2, p99=30.0)]
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_fleet_report_old_records_skip_autoscale_gates(tmp_path):
+    """A pre-autoscaler series (no fleet.active, no fleet.autoscale)
+    renders and passes exactly as before — the new gates never judge
+    old evidence."""
+    samples = []
+    for k in range(4):
+        s = _mk_fleet_sample(k + 1, 100.0 + k, autoscale=False)
+        del s["fleet"]["active"]
+        samples.append(s)
+    proc = _run_fleet_report(tmp_path, samples)
+    assert proc.returncode == 0, proc.stderr
+    assert "thrash" not in proc.stderr
+    assert "scale-event loss" not in proc.stderr
+
+
+def _run_serve_report(record, tmp_path):
+    path = tmp_path / "serving.json"
+    path.write_text(json.dumps(record) + "\n")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_report.py"),
+         "--file", str(path)], capture_output=True, text=True, cwd=REPO)
+
+
+def _autoscale_record(**over):
+    rec = {
+        "metric": "serve_captions_per_sec_per_chip", "value": 12.0,
+        "latency_p50_ms": 40.0, "latency_p99_ms": 90.0,
+        "completed": 18, "num_requests": 18, "shed": 0,
+        "recompiles_after_warmup": 0,
+        "autoscale": {"enabled": True, "min": 1, "max": 3,
+                      "started_at_min": True, "scaled_up": True,
+                      "scale_up_intervals": 4,
+                      "scale_up_budget_intervals": 40,
+                      "scaled_down": True, "scale_ups": 1,
+                      "scale_downs": 1, "replica_changes": 2,
+                      "no_thrash": True, "brownout_entries": 0,
+                      "rung": 0, "decisions": 2, "answered_ok": True},
+    }
+    rec["autoscale"].update(over)
+    return rec
+
+
+def test_serve_report_renders_autoscale_and_passes(tmp_path):
+    proc = _run_serve_report(_autoscale_record(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "autoscale drill" in proc.stdout
+    assert "scaled_up=True" in proc.stdout
+    assert "brownout" in proc.stdout
+
+
+@pytest.mark.parametrize("flag,needle", [
+    ("started_at_min", "did not start at"),
+    ("scaled_up", "never triggered a scale-up"),
+    ("scaled_down", "never drained back"),
+    ("no_thrash", "flapped"),
+    ("answered_ok", "lost or double-answered"),
+])
+def test_serve_report_gates_each_autoscale_flag(tmp_path, flag, needle):
+    proc = _run_serve_report(_autoscale_record(**{flag: False}),
+                             tmp_path)
+    assert proc.returncode == 1
+    assert needle in proc.stderr
+
+
+def test_serve_report_old_records_render_unchanged(tmp_path):
+    """A record with no autoscale section gains no rows, no gates —
+    the pin that old committed evidence re-renders as it always did."""
+    rec = {"metric": "serve_captions_per_sec_per_chip", "value": 12.0,
+           "latency_p50_ms": 40.0, "latency_p99_ms": 90.0,
+           "completed": 18, "num_requests": 18, "shed": 0,
+           "recompiles_after_warmup": 0}
+    proc = _run_serve_report(rec, tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "autoscale" not in proc.stdout
+    assert "brownout" not in proc.stdout
+
+
+# -- durable-rename satellite -----------------------------------------------
+
+
+def test_durable_rename_moves_and_overwrites(tmp_path):
+    from cst_captioning_tpu.resilience.integrity import durable_rename
+
+    src = tmp_path / "a.json"
+    dst = tmp_path / "b.json"
+    src.write_text("new")
+    dst.write_text("old")
+    durable_rename(str(src), str(dst))
+    assert not src.exists() and dst.read_text() == "new"
+
+
+def test_publishing_renames_go_through_the_discipline():
+    """Source pin: every rename that publishes a durable artifact uses
+    integrity.durable_rename, not a bare os.rename/os.replace — the
+    audit that closed the checkpoint-quarantine and metrics-rotation
+    stragglers stays closed."""
+    for rel in ("cst_captioning_tpu/training/checkpoint.py",
+                "cst_captioning_tpu/telemetry/fleetobs.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        assert "durable_rename" in src, rel
+        assert "os.rename(" not in src, rel
+
+
+# -- dataset fingerprint satellite ------------------------------------------
+
+
+def test_generate_without_features_skips_the_h5s(tmp_path):
+    from cst_captioning_tpu.data.synthetic import SyntheticSpec, generate
+
+    paths = generate(str(tmp_path), "train",
+                     SyntheticSpec(num_videos=4, captions_per_video=2),
+                     features=False)
+    assert "feat_h5" not in paths
+    assert not [f for f in os.listdir(tmp_path) if "feat" in f]
+    assert os.path.exists(paths["label_h5"])
+
+
+def test_dataset_fingerprint_roundtrip_and_drift(tmp_path):
+    """Two independent regenerations fingerprint identically (the
+    post-/tmp-wipe rebuild proof); a perturbed record is caught."""
+    script = os.path.join(REPO, "scripts", "dataset_fingerprint.py")
+    artifact = tmp_path / "fp.json"
+    args = ["--num_videos", "12", "--num_val", "4",
+            "--feat_dims", "16", "--feat_times", "2",
+            "--rich_vocab", "0", "--artifact", str(artifact)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    up = subprocess.run(
+        [sys.executable, script, *args, "--update"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert up.returncode == 0, up.stderr
+    chk = subprocess.run(
+        [sys.executable, script, *args, "--check"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert chk.returncode == 0, chk.stderr
+    assert "IDENTICAL" in chk.stdout
+    doc = json.loads(artifact.read_text())
+    doc["splits"]["train"]["label_h5"] = "0" * 64
+    doc["combined"] = "0" * 64
+    artifact.write_text(json.dumps(doc))
+    bad = subprocess.run(
+        [sys.executable, script, *args, "--check"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert bad.returncode == 1
+    assert "mismatch" in bad.stderr
+    spec = subprocess.run(
+        [sys.executable, script, *args, "--check",
+         "--num_videos", "13"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert spec.returncode == 1
+    assert "spec differs" in spec.stderr
+
+
+def test_committed_fingerprint_artifact_is_wellformed():
+    path = os.path.join(REPO, "artifacts", "dataset_fingerprint.json")
+    doc = json.load(open(path))
+    assert doc["schema"] == 1
+    assert doc["spec"]["num_videos"] == 6513      # the north-star scale
+    assert doc["spec"]["num_val"] == 497
+    assert set(doc["splits"]) == {"train", "val"}
+    for rec in doc["splits"].values():
+        assert len(rec["label_h5"]) == 64
+        assert len(rec["vocab_json"]) == 64
+
+
+# -- doc pins ---------------------------------------------------------------
+
+
+def test_serving_md_pins_the_autoscale_counter_table():
+    doc = open(os.path.join(REPO, "SERVING.md")).read()
+    assert "## Autoscaling & brownout" in doc
+    for name in AUTOSCALE_COUNTERS:
+        assert f"`{name}`" in doc, name
+    for why in ("brownout_deadline", "brownout_parked",
+                "brownout_stream"):
+        assert why in doc, why
+
+
+def test_observability_md_documents_the_decisions_log():
+    doc = open(os.path.join(REPO, "OBSERVABILITY.md")).read()
+    assert "autoscale_decisions.jsonl" in doc
+    assert "autoscale_decision" in doc
+
+
+def test_resilience_md_has_the_brownout_ladder_row():
+    doc = open(os.path.join(REPO, "RESILIENCE.md")).read()
+    assert "brownout" in doc.lower()
+    for rung in BROWNOUT_RUNGS:
+        assert rung in doc
+
+
+# -- slow: the real-subprocess burst drill ----------------------------------
+
+
+@pytest.mark.slow
+def test_cli_autoscale_burst_drill_end_to_end(tmp_path):
+    """THE acceptance drill through the real CLI: idle -> 4x burst ->
+    idle against real serve.py children — starts at --autoscale_min,
+    scales up within the scrape-interval budget, drains back down,
+    answers every request exactly once bit-identical to the fault-free
+    single-engine reference, zero post-warmup compiles, and the record
+    survives serve_report's + fleet_report's gates."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = str(tmp_path / "autoscale")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_supervisor.py"),
+         "--serve_demo", "1", "--autoscale_probe", "1",
+         "--autoscale_min", "1", "--autoscale_max", "3",
+         "--autoscale_up_cooldown_s", "1",
+         "--autoscale_down_cooldown_s", "1",
+         "--serve_demo_eos_bias", "-2", "--decode_chunk", "2",
+         "--beam_size", "1", "--fleet_scrape_ms", "200",
+         "--serve_lifecycle", "1",
+         "--supervise_dir", root],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    a = rec["autoscale"]
+    assert a["enabled"] and a["started_at_min"]
+    assert a["scaled_up"] and a["scaled_down"]
+    assert a["scale_up_intervals"] <= a["scale_up_budget_intervals"]
+    assert a["no_thrash"] and a["answered_ok"]
+    assert rec["completed"] == rec["num_requests"]
+    assert rec["recompiles_after_warmup"] == 0
+    sup = rec["supervisor"]
+    assert sup["parity_ok"] and sup["parity_mismatches"] == 0
+    # The durable decision trail exists and replays the story.
+    decisions = [json.loads(l) for l in
+                 open(os.path.join(root, "autoscale_decisions.jsonl"))]
+    acts = [d["action"] for d in decisions]
+    assert "scale_up" in acts and "scale_down" in acts
+    assert all(d["schema"] == AUTOSCALE_SCHEMA for d in decisions)
+    # Both report planes re-gate the evidence.
+    report = _run_serve_report(rec, tmp_path)
+    assert report.returncode == 0, report.stderr
+    fleet = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "fleet_report.py"),
+         "--dir", root], capture_output=True, text=True, cwd=REPO)
+    assert fleet.returncode == 0, fleet.stderr
+    assert "replica timeline" in fleet.stdout
