@@ -36,7 +36,8 @@ TRAIN_COMMON = \
         tune-fast tune-report serve-demo serve-bench serve-stream-bench \
         serve-chaos serve-fleet-bench serve-fleet-chaos serve-proc-bench \
         serve-proc-chaos serve-trace-demo fleet-obs-demo bf16-parity \
-        data-bench autoscale-bench autoscale-chaos dataset-regen clean
+        data-bench autoscale-bench autoscale-chaos journal-chaos \
+        dataset-regen clean
 
 # Default tier: everything except the `slow` subprocess chaos drills —
 # the same selection the tier-1 verify uses; `make chaos` runs the rest.
@@ -343,6 +344,32 @@ autoscale-chaos:
 	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
 	  $(PY) -m pytest tests/test_autoscale.py -q
 	$(MAKE) autoscale-bench
+
+# Durable-intake-journal chaos (SERVING.md "Durable intake journal"):
+# the full tests/test_journal.py suite sanitizer-armed — the torn-tail
+# byte-boundary sweep, duplicate suppression, the in-process
+# supervisor-death replay drill, plus the slow real-subprocess probe
+# tier-1 skips — then the CLI drill itself: SIGKILL the SUPERVISOR
+# (whole process group) mid-storm with streams in flight, relaunch on
+# the same journal dir, and gate the record with serve_report
+# (exactly-once / replay accounting / dup suppression / torn tail) and
+# the run dir with fleet_report (journal coverage cross-check against
+# the exit snapshot's high-water mark; the blackout gate is relaxed —
+# the scrape gap between the two supervisor incarnations IS the
+# deliberate SIGKILL window).
+journal-chaos:
+	CST_LOCK_SANITIZER=1 JAX_PLATFORMS=cpu \
+	  $(PY) -m pytest tests/test_journal.py -q
+	rm -rf /tmp/cst_journal && \
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_supervisor.py --serve_demo 1 \
+	  --journal_probe 1 --supervise_replicas 2 \
+	  --serve_demo_eos_bias -2 --decode_chunk 2 --beam_size 1 \
+	  --slo_p99_ms 60000 --slo_availability 0.5 \
+	  --supervise_dir /tmp/cst_journal \
+	  > /tmp/cst_serve_journal.json
+	$(PY) scripts/serve_report.py --file /tmp/cst_serve_journal.json
+	$(PY) scripts/fleet_report.py --dir /tmp/cst_journal \
+	  --blackout_factor 1000
 
 # Fleet-observability demo (OBSERVABILITY.md "Fleet plane"): the
 # seeded 3-child supervised drill with the scraper on a 200 ms cadence

@@ -51,6 +51,11 @@ HOT_PATHS = (
     # path — its state lock must stay declared, and it must never grow
     # a per-tick device fetch.
     "cst_captioning_tpu/serving/autoscale.py",
+    # The intake journal (ISSUE 20): its append sits on the accept path
+    # of every request (fsync-before-placement), and its high-water /
+    # counter state is read off-thread by the exit snapshot — the state
+    # lock and the scheduler's ownership of the maps must stay declared.
+    "cst_captioning_tpu/serving/journal.py",
 )
 
 #: Conversions that force a device->host sync when applied to a jax
@@ -229,6 +234,70 @@ def check_atomic_write(project: Project) -> Iterator[Violation]:
                     "open(<*.json path>, 'w') bypasses the atomic-write "
                     "discipline — use "
                     "resilience.integrity.atomic_json_write")
+
+
+# ---------------------------------------------------------------------------
+# journal-append
+# ---------------------------------------------------------------------------
+
+#: The one module allowed to open a write-ahead segment for writing —
+#: its ``_append`` is the single fsync'd frame-stamp-crc path every
+#: journal record must take (SERVING.md "Durable intake journal").
+_JOURNAL_HOME = "cst_captioning_tpu/serving/journal.py"
+
+
+def _wal_path_expr(node: ast.AST) -> bool:
+    """Does this expression syntactically look like a journal segment
+    path?  Literal ``*.wal`` suffixes, f-string tails, os.path.join
+    tails, and name hints ('...wal...'/'...journal...') — the same
+    heuristic shape as :func:`_json_path_expr`."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.endswith(".wal")
+    if isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        return isinstance(last, ast.Constant) and \
+            isinstance(last.value, str) and last.value.endswith(".wal")
+    if isinstance(node, ast.Call) and \
+            _dotted(node.func) in ("os.path.join", "posixpath.join") and \
+            node.args:
+        return _wal_path_expr(node.args[-1])
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _wal_path_expr(node.right)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        tail = node.id if isinstance(node, ast.Name) else node.attr
+        tail = tail.lower()
+        return "wal" in tail or "journal" in tail
+    return False
+
+
+def _is_mutating_mode(mode: Optional[ast.AST]) -> bool:
+    return (isinstance(mode, ast.Constant) and
+            isinstance(mode.value, str) and
+            ("w" in mode.value or "a" in mode.value or
+             "+" in mode.value))
+
+
+@rule("journal-append",
+      "write-ahead segments (*.wal) are written ONLY by serving/"
+      "journal.py's fsync'd append helper — a raw open elsewhere can "
+      "tear the exactly-once record")
+def check_journal_append(project: Project) -> Iterator[Violation]:
+    for f in project.files:
+        if f.tree is None or f.relpath == _JOURNAL_HOME:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) and \
+                    _dotted(node.func) == "open" and node.args and \
+                    _is_mutating_mode(_open_mode(node)) and \
+                    _wal_path_expr(node.args[0]):
+                yield Violation(
+                    "journal-append", f.relpath, node.lineno,
+                    node.col_offset,
+                    "open(<*.wal path>) for writing outside the journal "
+                    "module — every journal record must take "
+                    "IntakeJournal's one fsync'd append path (frame + "
+                    "schema stamp + crc), or replay after a crash will "
+                    "see bytes the supervisor never acknowledged")
 
 
 # ---------------------------------------------------------------------------

@@ -719,6 +719,57 @@ def _add_serving_args(p: argparse.ArgumentParser) -> None:
                         "to a fixed-size fault-free reference, zero "
                         "post-warmup compiles on surviving children; "
                         "emits the benchmark record line")
+    g.add_argument("--journal_dir",
+                   default=os.environ.get("CST_JOURNAL_DIR") or None,
+                   help="scripts/serve_supervisor.py: ARM the durable "
+                        "intake journal (serving/journal.py) in this "
+                        "directory — every accepted request is fsync'd "
+                        "BEFORE placement, stream chunks and terminal "
+                        "answers at send time, and a relaunch pointed "
+                        "at the same directory replays unanswered "
+                        "requests (TTLs preserved), answers duplicate "
+                        "idempotency keys from the record with zero "
+                        "decode work, and resumes streams from the "
+                        "journaled watermark (SERVING.md 'Durable "
+                        "intake journal').  Default off.  Env "
+                        "fallback: CST_JOURNAL_DIR")
+    g.add_argument("--journal_segment_bytes",
+                   type=_positive_int(
+                       "--journal_segment_bytes "
+                       "(or CST_JOURNAL_SEGMENT_BYTES)"),
+                   default=(os.environ.get("CST_JOURNAL_SEGMENT_BYTES")
+                            or 1048576),
+                   help="intake journal: rotate the active write-ahead "
+                        "segment after it passes this many bytes "
+                        "(rotation seals it through "
+                        "integrity.durable_rename; with compaction on, "
+                        "terminal records retire their entries so disk "
+                        "stays bounded).  Env fallback: "
+                        "CST_JOURNAL_SEGMENT_BYTES")
+    g.add_argument("--journal_compact",
+                   type=_nonneg_int(
+                       "--journal_compact (or CST_JOURNAL_COMPACT)",
+                       "keep every sealed segment (no compaction)"),
+                   default=os.environ.get("CST_JOURNAL_COMPACT") or 1,
+                   help="intake journal: 1 (default) = fold sealed "
+                        "segments into one compact file at every "
+                        "rotation, retiring journaled-terminal "
+                        "entries; 0 = keep every sealed segment (the "
+                        "forensic mode — disk grows with traffic).  "
+                        "Env fallback: CST_JOURNAL_COMPACT")
+    g.add_argument("--journal_probe", type=int, default=0,
+                   help="1 = scripts/serve_supervisor.py runs the "
+                        "supervisor-death drill instead of serving: "
+                        "storm a journal-armed supervisor subprocess "
+                        "with streams in flight, SIGKILL the "
+                        "SUPERVISOR (not a child) mid-storm, relaunch "
+                        "on the same --journal_dir, and pin every "
+                        "accepted request answered exactly once, "
+                        "captions bit-identical to a fault-free "
+                        "single-engine twin, stream prefixes "
+                        "consistent across the crash, duplicate ids "
+                        "answered from the journal, zero post-warmup "
+                        "compiles; emits the benchmark record line")
 
 
 def _add_bookkeeping_args(p: argparse.ArgumentParser) -> None:

@@ -209,5 +209,10 @@ def run_dp_pipeline(n_devices: int, batch_size: int | None = None,
         "fused_reward": float(scalars["fused_reward"]),
         "sp_ctx_sum": (None if sp_ctx_sum is None
                        else float(scalars["sp_ctx_sum"])),
+        # How many garble retries this result cost (0 on a clean first
+        # attempt, bounded at 2) — surfaced so callers/tests can assert
+        # the retry ladder was respected instead of inferring it from
+        # stdout (ISSUE 20 satellite).
+        "garble_retries": _attempt,
         "params": jax.device_get(state.params),
     }

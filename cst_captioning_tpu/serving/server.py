@@ -14,6 +14,18 @@ Protocol (one JSON object per line, either direction):
                        async track across the process boundary.
                        Ignored when absent — single-process wire
                        traffic is unchanged.
+                       "idem": "<key>" — the client's idempotency key
+                       (ISSUE 20).  This process does NOT dedup (the
+                       supervisor's intake journal owns exactly-once);
+                       the key is validated (string) and echoed on the
+                       request's terminal response so callers can
+                       correlate answers across a reconnect.
+  duplicate: a supervising front end with the intake journal armed
+             (scripts/serve_supervisor.py --journal_dir) answers a
+             resubmitted idempotency key from the journal:
+             {"id", ...the journaled terminal..., "idempotent": true}
+             with zero decode work (SERVING.md "Durable intake
+             journal")
   response:  {"id", "video_id", "caption", "latency_ms", "decode_steps"}
              (cache hits add "cached": true; streamed finals add
              "stream": true, "final": true, "chunks": N, "ttft_ms")
@@ -192,6 +204,8 @@ class CaptionServer:
             obj["chunks"] = int(comp.stream_chunks)
             if comp.ttft_s is not None:
                 obj["ttft_ms"] = round(comp.ttft_s * 1e3, 3)
+        if meta.get("idem") is not None:
+            obj["idem"] = meta["idem"]
         self._write(respond, obj)
         if self._lifecycle is not None:
             self._lifecycle.emit("responded", comp.request_id,
@@ -224,6 +238,8 @@ class CaptionServer:
         obj = self._mark_stream_terminal(
             {"id": meta.get("id"), "video_id": meta.get("video_id"),
              "error": error}, meta.get("stream"))
+        if meta.get("idem") is not None:
+            obj["idem"] = meta["idem"]
         if drop.reason == "expired":
             obj["where"] = drop.where              # "queued" | "resident"
         elif drop.reason == "deadline_shed":
@@ -401,6 +417,14 @@ class CaptionServer:
                                       "detail": "deadline_ms must be a "
                                                 "number >= 0"})
                 return
+        idem = req.get("idem")
+        if idem is not None and not isinstance(idem, str):
+            # Same wire verdict as the supervisor front end: the
+            # idempotency key is a string or absent, never coerced.
+            self._count_bad_line()
+            self._write(respond, {"id": rid, "error": "bad_request",
+                                  "detail": "idem must be a string"})
+            return
         feats = self.feats_for(vid)
         if feats is None:
             self._write(respond, {"id": rid, "error": "unknown_video",
@@ -408,6 +432,8 @@ class CaptionServer:
             return
         meta = {"id": rid, "video_id": vid, "respond": respond,
                 "stream": stream}
+        if idem is not None:
+            meta["idem"] = idem   # echoed on the terminal (docstring)
         tr = req.get("trace")
         if isinstance(tr, dict):
             # Cross-process trace context rides the meta into the

@@ -138,6 +138,11 @@ SUPERVISOR_COUNTERS = (
     "sup_bad_lines",          # unparseable/unattributable child lines
     "sup_replicas_added",     # autoscale grow: new replica slots spawned
     "sup_replicas_retired",   # autoscale shrink: slots drained out
+    "sup_journal_appends",    # intake-journal records fsync'd (ISSUE 20)
+    "sup_journal_replayed",   # pre-crash requests re-submitted at relaunch
+    "sup_journal_dup_hits",   # duplicate ids answered from the journal
+    "sup_journal_attached",   # duplicate ids attached to an open stream
+    "sup_journal_torn",       # torn journal records dropped at recovery
 )
 
 #: Declared acquisition order (cstlint:lock-order + the runtime
@@ -252,7 +257,8 @@ class ServeChild:
 
 def spawn_serve_child(argv: List[str], workdir: str, replica: int, *,
                       env: Optional[Dict[str, str]] = None,
-                      startup_timeout_s: float = 180.0) -> ServeChild:
+                      startup_timeout_s: float = 180.0,
+                      new_session: bool = False) -> ServeChild:
     """Spawn one serve.py child in socket mode and connect to it.
 
     The child's stderr goes to ``<workdir>/stderr.log`` (harvestable
@@ -260,13 +266,17 @@ def spawn_serve_child(argv: List[str], workdir: str, replica: int, *,
     ephemeral port (``--serve_port -1``) is scraped from that file's
     ``serve: listening on 127.0.0.1:<port>`` announcement.  Raises
     :class:`ChildStartupError` when the child exits or stays silent
-    past ``startup_timeout_s`` (jax import + warm compile dominate)."""
+    past ``startup_timeout_s`` (jax import + warm compile dominate).
+    ``new_session=True`` gives the child its own process group — the
+    journal drill (ISSUE 20) spawns a whole SUPERVISOR this way so one
+    ``killpg`` takes the coordinator and its children down together,
+    the worst-case death the intake journal must survive."""
     os.makedirs(workdir, exist_ok=True)
     stderr_path = os.path.join(workdir, "stderr.log")
     with open(stderr_path, "w") as errf:
         proc = subprocess.Popen(argv, stdin=subprocess.DEVNULL,
                                 stdout=subprocess.DEVNULL, stderr=errf,
-                                env=env)
+                                env=env, start_new_session=new_session)
     deadline = time.monotonic() + startup_timeout_s
     port = None
     while time.monotonic() < deadline:
@@ -321,6 +331,8 @@ class ProxyRequest:
     cur_tokens: int = 0         # tokens received from the CURRENT owner
     seq_out: int = 0            # supervisor-issued stream sequence
     requeues: int = 0
+    key: Optional[str] = None   # intake-journal idempotency key (ISSUE 20)
+    attached: bool = True       # False: journal-replayed, no live client
 
     def remaining_ms(self, now: float) -> Optional[float]:
         if self.ttl_ms is None:
@@ -380,7 +392,7 @@ class ProcessFleetSupervisor:
                  dump_grace_s: float = 2.0,
                  incident_dir: Optional[str] = None,
                  fault_plan=None, registry=None, lifecycle=None,
-                 fleet_obs=None, autoscaler=None,
+                 fleet_obs=None, autoscaler=None, journal=None,
                  clock: Callable[[], float] = time.monotonic,
                  spawn_async: bool = True):
         n = int(replicas)
@@ -409,12 +421,21 @@ class ProcessFleetSupervisor:
         # tightens the shed paths — same one-is-None-check-per-site
         # rule as fleet_obs.
         self._autoscaler = autoscaler
+        # Optional durable intake journal (serving/journal.py, ISSUE
+        # 20): accepts are fsync'd BEFORE placement, stream chunks and
+        # terminals at send time, so the supervisor process itself
+        # becomes a survivable failure domain — same one-is-None-check-
+        # per-site rule as fleet_obs/autoscaler.
+        self._journal = journal
         self.clock = clock
         self.spawn_async = spawn_async
         # Single-owner scheduler state (the module-docstring contract).
         self._replicas: List[ProcReplica] = [  # cstlint: owned_by=scheduler
             ProcReplica(k) for k in range(n)]
         self._pending: Dict[str, ProxyRequest] = {}  # cstlint: owned_by=scheduler
+        # Journal idempotency keys of OPEN requests -> their in-flight
+        # ProxyRequest (duplicate submits attach here; ISSUE 20).
+        self._inflight_keys: Dict[str, ProxyRequest] = {}  # cstlint: owned_by=scheduler
         self._incidents: List[Dict[str, Any]] = []  # cstlint: owned_by=scheduler
         self._seq = 0
         self._completed = 0
@@ -958,6 +979,10 @@ class ProcessFleetSupervisor:
                 "fatal_spent": rep.fatal_spent,
                 "last_rc": rep.last_rc,
                 "compiles": h.get("compiles"),
+                # The post-warm baseline (first health after (re)start):
+                # compiles - compiles0 is the replica's recompile count,
+                # readable over the wire by the journal drill (ISSUE 20).
+                "compiles0": rep.compiles0,
                 "min_service_ms": h.get("min_service_ms"),
                 "pid": (rep.child.pid if rep.child is not None
                         else None),
@@ -1043,6 +1068,8 @@ class ProcessFleetSupervisor:
             out["slo"] = self._fleet_obs.slo_status()
         if self._autoscaler is not None:
             out["autoscale"] = self._autoscaler.status()
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
         return out
 
     # -- routing -----------------------------------------------------------
@@ -1050,18 +1077,52 @@ class ProcessFleetSupervisor:
     def submit(self, client_id: Any, video_id: str, *,
                respond: Callable[[Dict[str, Any]], None],
                stream: bool = False, deadline_ms: Optional[float] = None,
-               no_cache: bool = False) -> None:
+               no_cache: bool = False, idem: Optional[str] = None,
+               have_seq: Optional[int] = None) -> None:
         """Accept one client request; every path answers eventually
-        (immediate shed/expiry answers now, through ``respond``)."""
+        (immediate shed/expiry answers now, through ``respond``).
+
+        With the intake journal armed (ISSUE 20) every request carries
+        an idempotency key — the wire's ``idem`` field, or
+        ``"<id>|<video_id>"`` when the client sent none.  A duplicate
+        of an already-TERMINAL key is answered from the journal with
+        zero decode work (``idempotent: true``); a duplicate of an
+        OPEN key attaches this channel to the in-flight request,
+        catching it up from the journaled chunk marks past
+        ``have_seq``.  Fresh accepts are fsync'd BEFORE placement."""
+        key = None
+        if self._journal is not None:
+            key = str(idem) if idem is not None \
+                else f"{client_id}|{video_id}"
+            prev = self._journal.terminal_for(key)
+            if prev is not None:
+                self._inc("sup_journal_dup_hits")
+                out = dict(prev)
+                out["id"] = client_id
+                out["idempotent"] = True
+                respond(out)
+                return
+            live = self._inflight_keys.get(key)
+            if live is not None:
+                self._attach(live, respond, have_seq)
+                return
         self._seq += 1
         pr = ProxyRequest(
             sup_id=f"s{self._seq}", client_id=client_id,
             video_id=str(video_id), stream=bool(stream), respond=respond,
             arrival=self.clock(),
             ttl_ms=(None if deadline_ms is None else float(deadline_ms)),
-            no_cache=bool(no_cache))
+            no_cache=bool(no_cache), key=key)
         self._inc("sup_requests")
         self._pending[pr.sup_id] = pr
+        if key is not None:
+            # Accept-before-placement: once this append returns, a
+            # supervisor crash cannot lose the request.
+            self._inflight_keys[key] = pr
+            self._journal.accept(
+                key, client_id, pr.video_id, stream=pr.stream,
+                ttl_ms=pr.ttl_ms, no_cache=pr.no_cache)
+            self._inc("sup_journal_appends")
         if self._lifecycle is not None:
             self._lifecycle.emit("received", pr.sup_id,
                                  client_id=client_id, video_id=video_id)
@@ -1083,6 +1144,99 @@ class ProcessFleetSupervisor:
                          reason="brownout_stream")
             return
         self._place(pr)
+
+    def _attach(self, pr: ProxyRequest, respond: Callable[[Dict[str, Any]],
+                None], have_seq: Optional[int]) -> None:
+        """A duplicate submit of an OPEN key adopts the new channel:
+        the journaled chunk marks past ``have_seq`` (all of them when
+        the client sent none) are replayed first, then live chunks and
+        the terminal flow to this channel — a prefix-consistent
+        continuation no matter where the reconnect fell."""
+        self._inc("sup_journal_attached")
+        pr.respond = respond
+        pr.attached = True
+        if pr.stream and pr.key is not None:
+            floor = -1 if have_seq is None else int(have_seq)
+            for m in self._journal.marks_for(pr.key):
+                if m["seq"] <= floor:
+                    continue
+                pr.respond({"id": pr.client_id, "video_id": pr.video_id,
+                            "stream": True, "seq": m["seq"],
+                            "tokens": list(m["tokens"]),
+                            "text": m["text"], "final": False})
+        if self._lifecycle is not None:
+            self._lifecycle.emit("queued", pr.sup_id,
+                                 where="journal_attach")
+
+    def replay_journal(self) -> Dict[str, Any]:
+        """Re-enter every accepted-but-unanswered pre-crash request
+        into the serving plane (called once by the front end right
+        after construction, children already live).  Arrival clocks
+        and remaining TTLs are preserved across the process death via
+        the journal's wall clock; stream watermarks are primed from
+        the journaled marks so continuation chunks start exactly where
+        the dead supervisor stopped sending.  Returns the recovery
+        ledger document (auditable via the blackbox/incident
+        machinery)."""
+        if self._journal is None:
+            return {"schema": 1, "enabled": False}
+        rec = self._journal.recovery
+        if rec.torn_records:
+            self._inc("sup_journal_torn", rec.torn_records)
+        now = self.clock()
+        replayed: List[Dict[str, Any]] = []
+        for acc in self._journal.open_requests():
+            key = acc["key"]
+            self._seq += 1
+            # Wall-clock delta is the ONLY clock that survives the
+            # dead process (monotonic-deadline's exemption: the
+            # journal's injected wall clock, not bare time.time()); it
+            # rebases the arrival into THIS incarnation's monotonic
+            # domain, never into a deadline comparison directly.
+            elapsed_s = max(
+                self._journal.wall() - acc["arrival_wall"], 0.0)
+            pr = ProxyRequest(
+                sup_id=f"s{self._seq}", client_id=acc["client_id"],
+                video_id=acc["video_id"], stream=bool(acc["stream"]),
+                respond=lambda obj: None,   # detached until a client
+                arrival=now - elapsed_s,    # re-submits the same key
+                ttl_ms=acc["ttl_ms"], no_cache=bool(acc["no_cache"]),
+                key=key, attached=False)
+            marks = self._journal.marks_for(key)
+            if marks:
+                last = marks[-1]
+                # cstlint: disable=device-scalar-fetch -- journaled JSON mark fields: host ints, never device arrays
+                pr.sent_tokens = int(last["sent_tokens"])
+                # cstlint: disable=device-scalar-fetch -- journaled JSON mark fields: host ints, never device arrays
+                pr.seq_out = int(last["seq"]) + 1
+            self._inc("sup_requests")
+            self._inc("sup_journal_replayed")
+            self._pending[pr.sup_id] = pr
+            self._inflight_keys[key] = pr
+            if self._lifecycle is not None:
+                # No "received" — intake happened in the DEAD process;
+                # the replayed-headed chain is accounted truncated
+                # (telemetry/lifecycle.EVENT_KINDS).
+                self._lifecycle.emit("replayed", pr.sup_id,
+                                     key=key, video_id=pr.video_id,
+                                     seq_out=pr.seq_out,
+                                     sent_tokens=pr.sent_tokens)
+            replayed.append({"key": key, "sup_id": pr.sup_id,
+                             "video_id": pr.video_id,
+                             "stream": bool(acc["stream"]),
+                             "sent_tokens": pr.sent_tokens,
+                             "seq_out": pr.seq_out})
+            self._place(pr)
+        self._dirty = True
+        return {
+            "schema": 1,
+            "enabled": True,
+            "replayed": replayed,
+            "recovered_terminals": len(rec.terminals),
+            "torn_records": rec.torn_records,
+            "segments_scanned": rec.segments_scanned,
+            "high_water": self._journal.high_water(),
+        }
 
     def _candidates(self, tried: Set[int]) -> List[ProcReplica]:
         """Live replicas not yet tried for this placement, in the
@@ -1324,6 +1478,13 @@ class ProcessFleetSupervisor:
                "tokens": [int(t) for t in out_toks],
                "text": out_text, "final": False}
         pr.seq_out += 1
+        if self._journal is not None and pr.key is not None:
+            # Watermark + chunk journaled at send time: a relaunch
+            # resumes exactly past what this append proves was sent,
+            # and a reconnecting client is caught up from the record.
+            self._journal.mark(pr.key, out["seq"], out["tokens"],
+                               out["text"], pr.sent_tokens)
+            self._inc("sup_journal_appends")
         pr.respond(out)
 
     def _terminal(self, rep: ProcReplica, pr: ProxyRequest,
@@ -1360,12 +1521,24 @@ class ProcessFleetSupervisor:
         elif self._lifecycle is not None:
             self._lifecycle.emit("dropped", pr.sup_id,
                                  reason=str(err), replica=rep.index)
+        self._journal_terminal(pr, out)
         pr.respond(out)
         if self._lifecycle is not None:
             self._lifecycle.emit("responded", pr.sup_id,
                                  status=(err or "ok"))
 
     # -- terminal answers the supervisor itself writes ---------------------
+
+    def _journal_terminal(self, pr: ProxyRequest,
+                          obj: Dict[str, Any]) -> None:
+        """Journal a terminal at send time and retire the open key —
+        EVERY terminal path (child answer, shed, expiry, drain reject)
+        funnels through here before ``respond``."""
+        if self._journal is None or pr.key is None:
+            return
+        self._inflight_keys.pop(pr.key, None)
+        self._journal.terminal(pr.key, obj)
+        self._inc("sup_journal_appends")
 
     def _finish(self, pr: ProxyRequest, obj: Dict[str, Any],
                 kind: str, **attrs) -> None:
@@ -1383,6 +1556,7 @@ class ProcessFleetSupervisor:
             self._lifecycle.emit(kind, pr.sup_id, **attrs)
             self._lifecycle.emit("responded", pr.sup_id,
                                  status=obj.get("error", "ok"))
+        self._journal_terminal(pr, obj)
         pr.respond(obj)
 
     def _answer_shed(self, pr: ProxyRequest) -> None:
@@ -1523,6 +1697,8 @@ class ProcessFleetSupervisor:
             rep.child.close()
             rep.child = None
             rep.state = "drained"
+        if self._journal is not None:
+            self._journal.close()
         self._update_snapshots()
 
 
@@ -1676,6 +1852,22 @@ class SupervisorServer:
                                       "detail": "deadline_ms must be a "
                                                 "number >= 0"})
                 return
+        idem = req.get("idem")
+        if idem is not None and not isinstance(idem, str):
+            self._count("serve_bad_lines")
+            self._write(respond, {"id": rid, "error": "bad_request",
+                                  "detail": "idem must be a string"})
+            return
+        have_seq = req.get("have_seq")
+        if have_seq is not None:
+            try:
+                have_seq = int(have_seq)
+            except (TypeError, ValueError):
+                self._count("serve_bad_lines")
+                self._write(respond, {"id": rid, "error": "bad_request",
+                                      "detail": "have_seq must be an "
+                                                "integer"})
+                return
         # Unknown-video stays the CHILD's verdict (it owns the feature
         # table) — the error comes back as a terminal and is forwarded,
         # so the wire semantics match serve.py exactly.
@@ -1683,7 +1875,8 @@ class SupervisorServer:
             rid, vid,
             respond=lambda obj: self._write(respond, obj),
             stream=(op == "stream"), deadline_ms=deadline_ms,
-            no_cache=bool(req.get("no_cache")))
+            no_cache=bool(req.get("no_cache")),
+            idem=idem, have_seq=have_seq)
 
     # -- scheduler loop ----------------------------------------------------
 

@@ -87,6 +87,12 @@ EVENT_KINDS = (
                      # action, replicas_before/after, rung) — id is the
                      # decision seq, not a request; same truncated-chain
                      # accounting as slo_alert (serving/autoscale.py)
+    "replayed",      # intake-journal replay re-entered it after a
+                     # supervisor relaunch (attrs: key, seq_out,
+                     # sent_tokens) — intake happened in the DEAD
+                     # process, so its chain has no `received` and
+                     # accounting counts it truncated, never a terminal
+                     # violation (serving/journal.py, ISSUE 20)
 )
 
 #: The kinds that END a request's story exactly once.  ``responded`` is

@@ -113,6 +113,18 @@ def main() -> int:
     # the attribution evidence it acted on.
     take("autoscale_decisions.jsonl")
 
+    # Intake-journal evidence (SERVING.md "Durable intake journal"):
+    # the recovery ledger a relaunched supervisor wrote (which ids it
+    # replayed vs answered from record) and the raw write-ahead
+    # segments themselves — small, line-framed, and the only ground
+    # truth for an exactly-once claim across a supervisor death.
+    take("recovery_ledger.json")
+    journal_root = os.path.join(src, "journal")
+    if os.path.isdir(journal_root):
+        for fn in sorted(os.listdir(journal_root)):
+            if fn.endswith(".wal"):
+                take(os.path.join("journal", fn))
+
     # Regenerate the report against the live out_dir so report + copies
     # agree, then keep both renderings.  A wedged/killed chain_report must
     # degrade to "bundle without report" — the MANIFEST below still gets

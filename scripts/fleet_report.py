@@ -36,6 +36,14 @@ Autoscale gates (active only when the run carried autoscaler data —
   engaged shows fleet p99 above the SLO p99 target (or
   ``--brownout_p99_ms``): shedding failed to protect admitted work.
 
+Journal gates (``--dir`` runs whose supervisor_exit.json carries a
+``journal`` block — ISSUE 20; journal-less runs are untouched): the
+journal directory is re-scanned and the report fails on a **coverage
+hole** (an accepted id missing from both the journal's terminal
+records and any terminal response) or a **high-water violation** (the
+exit snapshot's durable segment+offset mark names bytes that no longer
+exist).
+
 See OBSERVABILITY.md "Fleet plane" and SERVING.md "Autoscaling &
 brownout".
 """
@@ -217,6 +225,67 @@ def check_gates(samples: list, blackout_factor: float,
     return gates
 
 
+def check_journal(root: str) -> tuple:
+    """Intake-journal coverage cross-check (ISSUE 20) — ``(rows,
+    gates)``.  Active only when the run's ``supervisor_exit.json``
+    carries a journal block, so journal-less runs keep their verdicts
+    untouched.  The exit snapshot records the durable high-water mark
+    (segment + offset); re-scanning the journal directory here proves
+    no accepted id is missing from BOTH the journal's terminal records
+    and a terminal response — accepted work can crash, but it cannot
+    vanish."""
+    try:
+        with open(os.path.join(root, "supervisor_exit.json"),
+                  encoding="utf-8") as f:
+            jstats = (json.load(f) or {}).get("journal")
+    except (OSError, ValueError):
+        return [], []
+    if not isinstance(jstats, dict):
+        return [], []
+    from cst_captioning_tpu.serving.journal import scan_dir
+
+    jdir = jstats.get("dir") or os.path.join(root, "journal")
+    try:
+        rec = scan_dir(jdir)
+    except OSError as e:
+        return [], [f"journal dir unreadable: {jdir}: {e} — the exit "
+                    "snapshot says a journal was armed but its segments "
+                    "are gone (SERVING.md 'Durable intake journal')"]
+    uncovered = sorted(set(rec.accepts) - set(rec.terminals))
+    hw = jstats.get("high_water") or {}
+    rows = [("journal",
+             f"{len(rec.accepts)} accept(s) / {len(rec.terminals)} "
+             f"terminal(s) over {rec.segments_scanned} segment(s), "
+             f"{rec.torn_records} torn, high-water "
+             f"{hw.get('segment')}@{fmt(hw.get('offset'))}")]
+    gates = []
+    if uncovered:
+        gates.append(
+            f"journal coverage hole: {len(uncovered)} accepted id(s) "
+            "missing from BOTH the journal's terminal records and any "
+            f"terminal response (e.g. {', '.join(uncovered[:3])}) — "
+            "accepted work vanished across the run (SERVING.md "
+            "'Durable intake journal')")
+    seg = hw.get("segment")
+    if seg:
+        seg_path = os.path.join(jdir, seg)
+        if not os.path.exists(seg_path):
+            gates.append(
+                f"journal high-water segment missing: {seg} named by "
+                "the exit snapshot is not in the journal dir — "
+                "durable bytes were lost after the fsync that "
+                "acknowledged them (SERVING.md 'Durable intake "
+                "journal')")
+        elif os.path.getsize(seg_path) < int(hw.get("offset") or 0):
+            gates.append(
+                f"journal high-water truncated: {seg} is "
+                f"{os.path.getsize(seg_path)} byte(s), shorter than "
+                f"the exit snapshot's {hw.get('offset')} — the tail "
+                "the supervisor fsync'd is gone (SERVING.md 'Durable "
+                "intake journal')")
+    return rows, gates
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     src = p.add_mutually_exclusive_group(required=True)
@@ -314,6 +383,9 @@ def main(argv=None) -> int:
                                           f"{alerts_path}"))
             except OSError:
                 pass
+    journal_rows, journal_gates = ([], []) if not args.dir \
+        else check_journal(args.dir)
+    rows += journal_rows
     width = max(len(k) for k, _ in rows)
     print("fleet metrics")
     for k, v in rows:
@@ -322,6 +394,7 @@ def main(argv=None) -> int:
     gates = check_gates(samples, args.blackout_factor,
                         max_scale_changes=args.max_scale_changes,
                         brownout_p99_ms=args.brownout_p99_ms)
+    gates += journal_gates
     for msg in gates:
         print(f"  !! {msg}", file=sys.stderr)
     if args.json:

@@ -193,6 +193,34 @@ def main(argv=None) -> int:
              f"rung {fmt(autoscale.get('rung'))} at probe end, "
              f"{fmt(autoscale.get('brownout_entries'))} entr(ies)"),
         ]
+    journal = rec.get("journal") or {}
+    if journal.get("enabled"):
+        hw = journal.get("high_water") or {}
+        rows += [
+            ("journal drill",
+             f"killed_mid_storm={journal.get('killed_mid_storm')} "
+             f"({fmt(journal.get('terminals_before_kill'))} terminal(s), "
+             f"{fmt(journal.get('streams_in_flight_at_kill'))} stream(s) "
+             "in flight at SIGKILL)"),
+            ("journal replay",
+             f"{fmt(journal.get('replayed'))} replayed + "
+             f"{fmt(journal.get('recovered_terminals'))} already "
+             f"terminal (accounted={journal.get('replay_accounted')}, "
+             f"{fmt(journal.get('segments_scanned'))} segment(s), "
+             f"high-water {hw.get('segment')}@{fmt(hw.get('offset'))})"),
+            ("journal exactly-once",
+             f"exactly_once={journal.get('exactly_once')} — "
+             f"{fmt(journal.get('idempotent_answers'))} idempotent "
+             f"answer(s), {fmt(journal.get('dup_hits'))} dup hit(s), "
+             f"{fmt(journal.get('attached'))} attach(es), "
+             f"dup_suppressed={journal.get('dup_suppressed')}"),
+            ("journal torn tail",
+             f"{fmt(journal.get('torn_records'))} torn record(s) "
+             f"(torn_ok={journal.get('torn_ok')}), open_at_exit="
+             f"{fmt(journal.get('open_at_exit'))}, relaunch rc "
+             f"{fmt(journal.get('relaunch_rc'))} "
+             f"(clean_exit={journal.get('clean_exit')})"),
+        ]
     slo = rec.get("slo") or {}
     if slo.get("enabled"):
         firing = slo.get("firing") or []
@@ -329,6 +357,33 @@ def main(argv=None) -> int:
                   "events: the drain/requeue discipline dropped work "
                   "(SERVING.md 'Autoscaling & brownout')", file=sys.stderr)
             rc = 1
+    if journal.get("enabled") and (
+            journal.get("replay_accounted") is False
+            or journal.get("exactly_once") is False
+            or journal.get("clean_exit") is False):
+        print("  !! journal replay accounting broken: replayed + "
+              "recovered-terminal must cover every accepted id exactly "
+              "once and the relaunched supervisor must drain clean — "
+              "the write-ahead intake journal lost or double-served "
+              "work across the supervisor death (SERVING.md 'Durable "
+              "intake journal')", file=sys.stderr)
+        rc = 1
+    if journal.get("enabled") and journal.get("dup_suppressed") is False:
+        print("  !! duplicate-id suppression broken: a resubmit of an "
+              "already-terminal idempotency key must be answered from "
+              "the journaled terminal (idempotent: true, zero decode, "
+              "sup_requests untouched) (SERVING.md 'Durable intake "
+              "journal')", file=sys.stderr)
+        rc = 1
+    if journal.get("enabled") and (
+            journal.get("torn_ok") is False
+            or journal.get("killed_mid_storm") is False):
+        print("  !! torn-tail recovery broken: a SIGKILL mid-storm must "
+              "leave at most the one record being written torn, with "
+              "streams genuinely in flight at the kill — otherwise the "
+              "drill proved nothing (SERVING.md 'Durable intake "
+              "journal')", file=sys.stderr)
+        rc = 1
     if stream.get("enabled") and stream.get("prefix_ok") is False:
         print("  !! streamed chunks are not prefix-consistent with the "
               "final captions (SERVING.md 'Streaming & result cache')",

@@ -21,6 +21,12 @@ streaming, deadlines, and result semantics are unchanged (SERVING.md
     python scripts/serve_supervisor.py --serve_demo 1 \\
         --supervise_probe 1 --serve_demo_eos_bias -2
 
+    # the supervisor-death journal drill (SIGKILL the SUPERVISOR
+    # process group mid-storm, relaunch on the same --journal_dir,
+    # pin exactly-once / bit-identity / prefix-consistent streams):
+    python scripts/serve_supervisor.py --serve_demo 1 \\
+        --journal_probe 1 --serve_demo_eos_bias -2
+
 Supervisor specifics:
 
 - Child lifecycle is the EXIT TAXONOMY (resilience/exitcodes.py):
@@ -45,6 +51,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal
 import sys
 import tempfile
 import time
@@ -151,8 +158,21 @@ def build_autoscaler(opt, root: str, fleet_obs, *, registry=None,
         out_dir=root, registry=registry, lifecycle=lifecycle)
 
 
+def build_journal(opt):
+    """The durable intake journal (serving/journal.py, ISSUE 20) —
+    armed by ``--journal_dir``, disarmed (None) otherwise."""
+    if not getattr(opt, "journal_dir", None):
+        return None
+    from cst_captioning_tpu.serving.journal import IntakeJournal
+
+    return IntakeJournal(opt.journal_dir,
+                         segment_bytes=opt.journal_segment_bytes,
+                         compact=bool(opt.journal_compact))
+
+
 def build_supervisor(opt, root: str, *, plan=None, registry=None,
-                     lifecycle=None, fleet_obs=None, autoscaler=None):
+                     lifecycle=None, fleet_obs=None, autoscaler=None,
+                     journal=None):
     from cst_captioning_tpu.serving.supervisor import ProcessFleetSupervisor
 
     # An armed autoscaler owns the fleet size: boot at --autoscale_min
@@ -166,7 +186,31 @@ def build_supervisor(opt, root: str, *, plan=None, registry=None,
         wedge_timeout_s=opt.wedge_timeout,
         incident_dir=os.path.join(root, "incidents"),
         fault_plan=plan, registry=registry, lifecycle=lifecycle,
-        fleet_obs=fleet_obs, autoscaler=autoscaler)
+        fleet_obs=fleet_obs, autoscaler=autoscaler, journal=journal)
+
+
+def replay_and_ledger(sup, root: str) -> dict:
+    """Replay the journal into the freshly-built supervisor and write
+    the recovery ledger where the incident machinery lives, so every
+    replayed id is auditable (collect_evidence bundles it)."""
+    from cst_captioning_tpu.resilience.integrity import atomic_json_write
+
+    ledger = sup.replay_journal()
+    if not ledger.get("enabled"):
+        return ledger
+    try:
+        atomic_json_write(os.path.join(root, "recovery_ledger.json"),
+                          ledger, indent=2)
+    except OSError as e:
+        print(f"serve_supervisor: recovery ledger write failed: {e}",
+              file=sys.stderr)
+    n = len(ledger.get("replayed") or [])
+    if n or ledger.get("torn_records"):
+        print(f"serve_supervisor: journal replay: {n} request(s) "
+              f"re-entered, {ledger.get('recovered_terminals', 0)} "
+              f"already terminal, {ledger.get('torn_records', 0)} torn "
+              "record(s) dropped", file=sys.stderr)
+    return ledger
 
 
 def build_observability(opt, root: str, registry):
@@ -220,15 +264,22 @@ def close_observability(tracer, fleet_obs) -> None:
 def write_supervisor_exit(root: str, rc: int, sup, registry) -> None:
     """The supervisor's own exit snapshot (the train.py discipline):
     final stats + fleet health + registry telemetry, atomically, where
-    collect_evidence finds it next to the incident bundles."""
+    collect_evidence finds it next to the incident bundles.  With the
+    intake journal armed, the top-level ``journal`` block records the
+    durable segment + offset high-water mark so fleet_report.py can
+    cross-check that no accepted id is missing from both the journal
+    and a terminal response (ISSUE 20)."""
     from cst_captioning_tpu.resilience.integrity import atomic_json_write
 
+    doc = {"rc": rc, "stats": sup.stats(),
+           "health": sup.health_payload(),
+           "telemetry": registry.snapshot()}
+    journal = getattr(sup, "_journal", None)
+    if journal is not None:
+        doc["journal"] = journal.stats()
     try:
         atomic_json_write(
-            os.path.join(root, "supervisor_exit.json"),
-            {"rc": rc, "stats": sup.stats(),
-             "health": sup.health_payload(),
-             "telemetry": registry.snapshot()}, indent=2)
+            os.path.join(root, "supervisor_exit.json"), doc, indent=2)
     except OSError as e:
         print(f"serve_supervisor: exit snapshot write failed: {e}",
               file=sys.stderr)
@@ -766,6 +817,419 @@ def run_autoscale_probe(opt) -> int:
 
 
 # ---------------------------------------------------------------------------
+# the supervisor-death journal drill (--journal_probe 1, ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _supervisor_argv(opt, root: str, journal_dir: str) -> list:
+    """A whole serve_supervisor.py command line for the journal drill:
+    the drill spawns the SUPERVISOR itself as a subprocess (socket
+    mode, ephemeral port) so SIGKILLing it is a real process death,
+    not an in-process simulation.  Serving shape flags are forwarded
+    explicitly, like :func:`child_argv` — both incarnations get the
+    byte-identical argv, which is the point: recovery must come from
+    the journal, not from flags."""
+    argv = [sys.executable,
+            os.path.join(REPO, "scripts", "serve_supervisor.py"),
+            "--serve_port", "-1",
+            "--supervise_dir", root,
+            "--journal_dir", journal_dir,
+            "--loglevel", "WARNING"]
+    forward = [("--supervise_replicas", opt.supervise_replicas),
+               ("--supervise_restart_limit", opt.supervise_restart_limit),
+               ("--supervise_backoff_ms", opt.supervise_backoff_ms),
+               ("--journal_segment_bytes", opt.journal_segment_bytes),
+               ("--journal_compact", opt.journal_compact),
+               ("--fleet_scrape_ms", opt.fleet_scrape_ms),
+               ("--slo_p99_ms", opt.slo_p99_ms),
+               ("--slo_availability", opt.slo_availability),
+               ("--slo_error_rate", opt.slo_error_rate),
+               ("--serve_demo", opt.serve_demo),
+               ("--serve_demo_eos_bias", opt.serve_demo_eos_bias),
+               ("--beam_size", opt.beam_size),
+               ("--max_length", opt.max_length),
+               ("--length_norm", opt.length_norm),
+               ("--decode_chunk", getattr(opt, "decode_chunk", 8)),
+               ("--serve_buckets", opt.serve_buckets),
+               ("--serve_queue_limit", opt.serve_queue_limit),
+               ("--serve_deadline_ms", opt.serve_deadline_ms),
+               ("--serve_cache", opt.serve_cache),
+               ("--serve_recover", opt.serve_recover),
+               ("--serve_retry_limit", opt.serve_retry_limit),
+               ("--serve_rebuild_limit", opt.serve_rebuild_limit),
+               ("--serve_step_budget_ms", opt.serve_step_budget_ms),
+               ("--serve_lifecycle", opt.serve_lifecycle),
+               ("--serve_lifecycle_events", opt.serve_lifecycle_events),
+               ("--wedge_timeout", opt.wedge_timeout),
+               ("--compile_cache_dir",
+                getattr(opt, "compile_cache_dir", ""))]
+    for flag, val in forward:
+        argv += [flag, str(val)]
+    if not opt.serve_demo:
+        argv += ["--checkpoint_path", opt.checkpoint_path,
+                 "--test_label_h5", str(opt.test_label_h5),
+                 "--test_info_json", str(opt.test_info_json)]
+        argv += ["--test_feat_h5"] + [str(p) for p in opt.test_feat_h5]
+        if opt.test_cocofmt_file:
+            argv += ["--test_cocofmt_file", str(opt.test_cocofmt_file)]
+    return argv
+
+
+def _is_terminal(obj: dict) -> bool:
+    return bool(obj.get("final")) or "error" in obj
+
+
+def _drain_into(child, answers: dict) -> None:
+    for raw in child.lines():
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            continue
+        rid = obj.get("id")
+        if rid is not None:
+            answers.setdefault(rid, []).append(obj)
+
+
+def _wire_stats(child, answers: dict, timeout_s: float = 30.0) -> dict:
+    """One {"op": "stats"} round trip; stray request lines that arrive
+    interleaved are routed into ``answers``, never dropped."""
+    child.send_line(json.dumps({"op": "stats"}))
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        for raw in child.lines():
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            if obj.get("op") == "stats":
+                return obj
+            if obj.get("id") is not None:
+                answers.setdefault(obj["id"], []).append(obj)
+        if child.poll() is not None:
+            raise RuntimeError(
+                f"supervisor exited {child.poll()} during stats query")
+        time.sleep(0.005)
+    raise RuntimeError("supervisor stats query timed out")
+
+
+def run_journal_probe(opt) -> int:
+    """The ISSUE 20 acceptance drill, machine-checked, through the real
+    CLI: storm a journal-armed supervisor SUBPROCESS with streams in
+    flight, SIGKILL the whole supervisor process group mid-storm (the
+    coordinator and its children die together — the worst-case death),
+    relaunch on the same ``--journal_dir``, resubmit every id with its
+    idempotency key and stream watermark, and pin:
+
+    - exactly once: every accepted id answered, never twice
+      authoritatively — already-terminal ids are answered from the
+      journal (``idempotent: true``) with zero decode work;
+    - bit-identity: every caption equals the fault-free single-engine
+      twin's, across the crash;
+    - prefix consistency: pre-kill chunks + post-relaunch chunks form
+      one gapless prefix of the final caption;
+    - replay accounting: the recovery ledger covers every accepted id
+      (replayed + recovered-terminal == accepted), at most one torn
+      record, journal open-set empty at clean exit;
+    - zero post-warmup compiles in the relaunched incarnation.
+
+    Prints the one-JSON-line record scripts/serve_report.py renders
+    and exit-1 gates."""
+    from cst_captioning_tpu.resilience.exitcodes import EXIT_PREEMPTED
+    from cst_captioning_tpu.serving.supervisor import spawn_serve_child
+
+    root = opt.supervise_dir or tempfile.mkdtemp(prefix="cst_journal_")
+    os.makedirs(root, exist_ok=True)
+    journal_dir = opt.journal_dir or os.path.join(root, "journal")
+    argv = _supervisor_argv(opt, root, journal_dir)
+
+    num_requests = 12
+    kill_after_terminals = 2
+    video_ids = [f"v{i % 6}" for i in range(num_requests)]
+    qid = [f"q{i}" for i in range(num_requests)]
+
+    reference = _single_engine_reference(opt, root, sorted(set(video_ids)))
+
+    # ---- incarnation 1: storm, then SIGKILL the process group --------
+    p1: dict = {}
+    sup1 = spawn_serve_child(argv, os.path.join(root, "sup1"), 0,
+                             env=dict(os.environ), startup_timeout_s=600.0,
+                             new_session=True)
+    t0 = time.monotonic()
+    try:
+        for i in range(num_requests):
+            sup1.send_line(json.dumps(
+                {"id": qid[i], "video_id": video_ids[i], "op": "stream",
+                 "idem": f"k{i}"}))
+        deadline = time.monotonic() + 300.0
+        while True:
+            if sup1.poll() is not None:
+                raise RuntimeError(
+                    f"supervisor exited {sup1.poll()} before the kill")
+            _drain_into(sup1, p1)
+            terms = sum(1 for objs in p1.values()
+                        if any(_is_terminal(o) for o in objs))
+            if terms >= kill_after_terminals:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"storm stalled: only {terms} terminal(s) in 300s")
+            time.sleep(0.005)
+        # The worst-case death: supervisor AND children in one shot
+        # (new_session=True made the supervisor a process-group
+        # leader, so killpg reaches every child it spawned).
+        os.killpg(sup1.proc.pid, signal.SIGKILL)
+        sup1.proc.wait()
+        time.sleep(0.2)  # let the reader thread flush buffered lines
+        _drain_into(sup1, p1)
+    finally:
+        sup1.close()
+
+    p1_term = {r: [o for o in objs if _is_terminal(o)]
+               for r, objs in p1.items()}
+    terminals_at_kill = sum(1 for t in p1_term.values() if t)
+    streams_in_flight = sum(
+        1 for i in range(num_requests)
+        if not p1_term.get(qid[i])
+        and any(o.get("stream") and not o.get("final")
+                for o in p1.get(qid[i], [])))
+    killed_mid_storm = (terminals_at_kill >= 1 and streams_in_flight >= 1)
+
+    # ---- incarnation 2: relaunch on the same journal, resubmit ------
+    p2: dict = {}
+    rc = 0
+    sup2 = spawn_serve_child(argv, os.path.join(root, "sup2"), 0,
+                             env=dict(os.environ), startup_timeout_s=600.0,
+                             new_session=True)
+    try:
+        for i in range(num_requests):
+            req = {"id": qid[i], "video_id": video_ids[i],
+                   "op": "stream", "idem": f"k{i}"}
+            seqs = [o["seq"] for o in p1.get(qid[i], [])
+                    if o.get("stream") and not o.get("final")]
+            if seqs:
+                # The client-side watermark: chunks at or below this
+                # seq were already delivered pre-kill; the attach path
+                # must resume strictly past it.
+                req["have_seq"] = max(seqs)
+            sup2.send_line(json.dumps(req))
+        deadline = time.monotonic() + 600.0
+        while True:
+            if sup2.poll() is not None:
+                raise RuntimeError(
+                    f"relaunched supervisor exited {sup2.poll()} early")
+            _drain_into(sup2, p2)
+            done = sum(1 for i in range(num_requests)
+                       if any(_is_terminal(o)
+                              for o in p2.get(qid[i], [])))
+            if done >= num_requests:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"relaunch drill timed out with {done} of "
+                    f"{num_requests} resubmits answered")
+            time.sleep(0.005)
+        makespan = time.monotonic() - t0
+
+        # Duplicate-id suppression, pinned against the counters: one
+        # extra submit of an already-terminal key must be answered
+        # from the journal (idempotent, zero decode) without touching
+        # sup_requests.
+        stats_before = _wire_stats(sup2, p2)
+        sup2.send_line(json.dumps(
+            {"id": "qdup", "video_id": video_ids[0], "op": "stream",
+             "idem": "k0"}))
+        dup_deadline = time.monotonic() + 60.0
+        while not any(_is_terminal(o) for o in p2.get("qdup", [])):
+            if time.monotonic() > dup_deadline:
+                raise RuntimeError("duplicate submit never answered")
+            _drain_into(sup2, p2)
+            time.sleep(0.005)
+        stats_after = _wire_stats(sup2, p2)
+
+        dup_fin = next(o for o in p2["qdup"] if _is_terminal(o))
+        dup_suppressed = (
+            dup_fin.get("idempotent") is True
+            and dup_fin.get("caption") == reference.get(video_ids[0])
+            and stats_after["supervisor"]["sup_requests"]
+            == stats_before["supervisor"]["sup_requests"]
+            and stats_after["supervisor"]["sup_journal_dup_hits"]
+            > stats_before["supervisor"]["sup_journal_dup_hits"])
+
+        recompiles = 0
+        for rep in stats_after.get("per_replica") or []:
+            if rep.get("compiles") is not None \
+                    and rep.get("compiles0") is not None:
+                recompiles += max(
+                    0, int(rep["compiles"]) - int(rep["compiles0"]))
+    finally:
+        sup2.terminate()
+        end = time.monotonic() + 120.0
+        rc2 = None
+        while time.monotonic() < end:
+            rc2 = sup2.poll()
+            if rc2 is not None:
+                break
+            time.sleep(0.05)
+        sup2.close()
+    clean_exit = rc2 == EXIT_PREEMPTED
+
+    # ---- the durable evidence: ledger + exit snapshot ----------------
+    ledger: dict = {}
+    try:
+        with open(os.path.join(root, "recovery_ledger.json")) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        pass
+    exit_doc: dict = {}
+    try:
+        with open(os.path.join(root, "supervisor_exit.json")) as f:
+            exit_doc = json.load(f)
+    except (OSError, ValueError):
+        pass
+
+    replayed = ledger.get("replayed") or []
+    replayed_keys = {r.get("key") for r in replayed}
+    recovered_terminals = int(ledger.get("recovered_terminals") or 0)
+    torn_records = int(ledger.get("torn_records") or 0)
+    open_at_exit = (exit_doc.get("journal") or {}).get("open")
+
+    # ---- gates -------------------------------------------------------
+    completed = 0
+    mismatches = 0
+    exactly_once = True
+    prefix_ok = True
+    chunks_total = 0
+    idempotent_answers = 0
+    for i in range(num_requests):
+        objs = p1.get(qid[i], []) + p2.get(qid[i], [])
+        terminal = [o for o in objs if _is_terminal(o)]
+        authoritative = [o for o in terminal if not o.get("idempotent")]
+        idempotent_answers += len(terminal) - len(authoritative)
+        if not terminal or len(authoritative) > 1:
+            exactly_once = False
+        captions = {o.get("caption") for o in terminal
+                    if "caption" in o}
+        if len(captions) != 1:
+            exactly_once = False
+            continue
+        cap = captions.pop()
+        completed += 1
+        if cap != reference.get(video_ids[i]):
+            mismatches += 1
+        # Prefix consistency across the crash: pre-kill + post-attach
+        # chunks, deduped by seq (the attach replay may legitimately
+        # resend a chunk the OS socket buffer delivered at kill time),
+        # must be one gapless prefix of the final caption.  A replay
+        # that finished detached delivers the caption via the
+        # idempotent terminal with no tail chunks — still a prefix.
+        by_seq: dict = {}
+        for o in objs:
+            if o.get("stream") and not o.get("final"):
+                if by_seq.setdefault(o["seq"], o["text"]) != o["text"]:
+                    prefix_ok = False
+        chunks_total += len(by_seq)
+        if sorted(by_seq) != list(range(len(by_seq))):
+            prefix_ok = False
+            continue
+        text = " ".join(by_seq[s] for s in sorted(by_seq)
+                        if by_seq[s]).strip()
+        if not cap.startswith(text):
+            prefix_ok = False
+    answered = completed == num_requests
+    parity_ok = answered and mismatches == 0
+    covered_ok = all(
+        f"k{i}" in replayed_keys
+        or any(o.get("idempotent") for o in p2.get(qid[i], [])
+               if _is_terminal(o))
+        for i in range(num_requests))
+    replay_accounted = (
+        covered_ok
+        and len(replayed) + recovered_terminals == num_requests
+        and open_at_exit == 0)
+    torn_ok = torn_records <= 1
+
+    c = stats_after["supervisor"]
+    lat = [stats_after.get("latency_p50_ms"),
+           stats_after.get("latency_p99_ms")]
+    slo_status = stats_after.get("slo") or {}
+    slo_ok = not slo_status.get("firing")
+    record = {
+        "metric": SERVE_METRIC, "schema": 1,
+        "value": round(completed / makespan, 2) if makespan else None,
+        "platform": "cpu" if os.environ.get(
+            "JAX_PLATFORMS") == "cpu" else "supervised",
+        "completed": completed, "num_requests": num_requests,
+        "shed": c["sup_shed"], "makespan_s": round(makespan, 3),
+        "latency_p50_ms": lat[0], "latency_p99_ms": lat[1],
+        "beam_size": opt.beam_size,
+        "decode_chunk": getattr(opt, "decode_chunk", 8),
+        "buckets": opt.serve_buckets,
+        "recompiles_after_warmup": recompiles,
+        "stream": {"enabled": True, "prefix_ok": prefix_ok,
+                   "chunks": chunks_total},
+        "slo": {"enabled": slo_status.get("enabled", False),
+                "firing": slo_status.get("firing", []),
+                "alerts_fired": slo_status.get("alerts_fired", 0),
+                "alerts_cleared": slo_status.get("alerts_cleared", 0),
+                "ok": slo_ok},
+        "supervisor": {
+            "enabled": True,
+            "replicas": opt.supervise_replicas,
+            "restart_limit": opt.supervise_restart_limit,
+            "killed_replica": None,
+            "restarts": c["sup_replica_restarts"],
+            "requeued": c["sup_requeued"],
+            "deaths": c["sup_replica_deaths"],
+            "wedge_kills": c["sup_wedge_kills"],
+            "budget_ok": c["sup_replica_deaths"] == 0,
+            "parity_ok": parity_ok,
+            "parity_mismatches": mismatches,
+            "incidents": len(stats_after.get("incidents") or []),
+            "blackbox_harvested": True,
+            "per_replica": stats_after.get("per_replica") or [],
+        },
+        "journal": {
+            "enabled": True,
+            "dir": journal_dir,
+            "killed_mid_storm": killed_mid_storm,
+            "terminals_before_kill": terminals_at_kill,
+            "streams_in_flight_at_kill": streams_in_flight,
+            "replayed": len(replayed),
+            "recovered_terminals": recovered_terminals,
+            "replay_accounted": replay_accounted,
+            "exactly_once": exactly_once,
+            "idempotent_answers": idempotent_answers,
+            "dup_suppressed": dup_suppressed,
+            "dup_hits": c["sup_journal_dup_hits"],
+            "attached": c["sup_journal_attached"],
+            "torn_records": torn_records,
+            "torn_ok": torn_ok,
+            "segments_scanned": ledger.get("segments_scanned"),
+            "high_water": ledger.get("high_water"),
+            "open_at_exit": open_at_exit,
+            "relaunch_rc": rc2,
+            "clean_exit": clean_exit,
+        },
+    }
+    print(json.dumps(record))
+    report = {
+        "answered": answered, "exactly_once": exactly_once,
+        "parity_ok": parity_ok, "prefix_ok": prefix_ok,
+        "recompiles": recompiles,
+        "replay_accounted": replay_accounted,
+        "dup_suppressed": dup_suppressed, "torn_ok": torn_ok,
+        "killed_mid_storm": killed_mid_storm, "clean_exit": clean_exit,
+    }
+    print(f"serve_supervisor: journal probe {json.dumps(report)}",
+          file=sys.stderr)
+    if not all([answered, exactly_once, parity_ok, prefix_ok,
+                recompiles == 0, replay_accounted, dup_suppressed,
+                torn_ok, killed_mid_storm, clean_exit]):
+        rc = 1
+    return rc
+
+
+# ---------------------------------------------------------------------------
 # serving mode
 # ---------------------------------------------------------------------------
 
@@ -796,9 +1260,14 @@ def run_serving(opt) -> int:
 
     autoscaler = build_autoscaler(opt, root, fleet_obs,
                                   registry=registry, lifecycle=lifecycle)
+    journal = build_journal(opt)
     sup = build_supervisor(opt, root, plan=plan, registry=registry,
                            lifecycle=lifecycle, fleet_obs=fleet_obs,
-                           autoscaler=autoscaler)
+                           autoscaler=autoscaler, journal=journal)
+    # Children are live: replay the pre-crash journal BEFORE the wire
+    # opens, so duplicate resubmits attach to the replay instead of
+    # racing it (the recovery ledger lands next to the incidents).
+    replay_and_ledger(sup, root)
     blackbox = (os.path.join(root, "blackbox.json")
                 if opt.serve_blackbox else None)
     server = SupervisorServer(sup, handler=handler, registry=registry,
@@ -879,6 +1348,8 @@ def main(argv=None) -> int:
               "--test_feat_h5/--test_label_h5/--test_info_json (or pass "
               "--serve_demo 1)", file=sys.stderr)
         return 2
+    if getattr(opt, "journal_probe", 0):
+        return run_journal_probe(opt)
     if getattr(opt, "autoscale_probe", 0):
         return run_autoscale_probe(opt)
     if opt.supervise_probe:

@@ -77,6 +77,16 @@ os.environ["CST_AUTOSCALE_QUEUE_HI_MS"] = ""
 os.environ["CST_AUTOSCALE_UP_COOLDOWN_S"] = ""
 os.environ["CST_AUTOSCALE_DOWN_COOLDOWN_S"] = ""
 
+# Intake-journal env knobs (ISSUE 20): an operator's exported journal
+# directory / segment size / compaction switch (opts.py resolves
+# CST_JOURNAL_* as argparse defaults) must not change what the suite
+# pins — a leaked CST_JOURNAL_DIR would silently ARM the journal in
+# every spawned supervisor.  '' falls back to the built-in defaults;
+# journal tests pass explicit values instead.
+os.environ["CST_JOURNAL_DIR"] = ""
+os.environ["CST_JOURNAL_SEGMENT_BYTES"] = ""
+os.environ["CST_JOURNAL_COMPACT"] = ""
+
 # Data-plane env knobs (ISSUE 15): an operator's exported worker count or
 # shard assignment (opts.py resolves CST_LOADER_WORKERS/CST_DATA_SHARDS/
 # CST_DATA_SHARD_ID as argparse defaults) must not change what the suite

@@ -1,0 +1,17 @@
+"""Near-miss negative: reading segments is every consumer's right
+(replay, fleet_report's coverage re-scan), and ordinary files keep
+their ordinary writes."""
+
+import os
+
+
+def read_only_scan(root):
+    # silent: read mode — scanning sealed segments is not an append
+    with open(os.path.join(root, "seg-00000001.wal"), "rb") as f:
+        return f.read()
+
+
+def unrelated_write(root):
+    # silent: not a journal segment path
+    with open(os.path.join(root, "notes.txt"), "a") as f:
+        f.write("x")

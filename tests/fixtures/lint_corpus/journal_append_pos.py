@@ -1,0 +1,18 @@
+"""Seeded positive: raw writes to write-ahead segments outside
+serving/journal.py — both the literal-suffix and the name-hint
+spellings must fire."""
+
+import os
+
+
+def raw_segment_append(root):
+    # fires: appending to a *.wal path bypasses the one fsync'd
+    # frame+crc append helper
+    with open(os.path.join(root, "seg-00000001.wal"), "a") as f:
+        f.write("{}\n")
+
+
+def raw_write_by_name(journal_path):
+    # fires: a name hinting at the journal opened for (over)writing
+    with open(journal_path, "wb") as f:
+        f.write(b"")

@@ -58,6 +58,10 @@ AST_CORPUS = {
     "thread-discipline": ("thread_discipline",
                           "cst_captioning_tpu/data/somemodule.py"),
     "monotonic-deadline": ("monotonic_deadline", "scripts/somescript.py"),
+    # The intake journal's single-append-path rule (ISSUE 20): *.wal
+    # writes outside serving/journal.py tear the exactly-once record.
+    "journal-append": ("journal_append",
+                       "cst_captioning_tpu/serving/somemodule.py"),
 }
 
 
@@ -101,6 +105,14 @@ def test_atomic_write_home_module_exempt():
     text = corpus_text("atomic_write", "pos")
     assert run_rule("atomic-write", text,
                     "cst_captioning_tpu/resilience/integrity.py") == []
+
+
+def test_journal_append_home_module_exempt():
+    """serving/journal.py itself must spell the raw segment write —
+    its _append IS the discipline the rule enforces elsewhere."""
+    text = corpus_text("journal_append", "pos")
+    assert run_rule("journal-append", text,
+                    "cst_captioning_tpu/serving/journal.py") == []
 
 
 def test_bare_except_scoped_to_failure_domains():
@@ -323,7 +335,7 @@ def test_every_shipped_rule_registered():
                 "exit-taxonomy", "bare-except-swallow", "donation-audit",
                 "guarded-by", "thread-ownership", "lock-order",
                 "signal-safe-handler", "thread-discipline",
-                "monotonic-deadline"}
+                "monotonic-deadline", "journal-append"}
     assert expected <= set(RULES)
     for name in ("guarded-by", "thread-ownership", "lock-order",
                  "signal-safe-handler", "thread-discipline",

@@ -1,0 +1,603 @@
+"""Durable intake journal (ISSUE 20): exactly-once across supervisor death.
+
+Fast slice (tier-1, lock-sanitizer armed, NO jax import — the journal is
+pure host code like the supervisor it serves):
+- write/scan round-trip: accepts, chunk marks, terminals survive a
+  close + reopen; every open starts a FRESH segment so recovery
+  evidence stays byte-frozen;
+- THE torn-tail sweep: the active segment truncated at EVERY byte
+  boundary of its final record — a SEALED record (checksummed +
+  newline-terminated) is never dropped and never double-applied, and
+  the scan never crashes;
+- segment rotation + compaction bound disk while preserving the exact
+  recoverable state (terminals retire their accept/mark entries);
+- duplicate-id suppression through the supervisor: a resubmit of an
+  already-terminal idempotency key is answered from the record with
+  ``idempotent: true`` and ZERO decode work; a duplicate of an OPEN
+  key attaches the new channel and catches it up from the journaled
+  marks past ``have_seq``;
+- the in-process supervisor-death drill against the strict FakeChild
+  harness (tests/test_supervisor.py): storm streams, abandon the
+  supervisor mid-stream WITHOUT drain (the SIGKILL analogue), rebuild
+  on the same journal dir, replay — every request answered exactly
+  once, captions bit-identical, chunk seqs contiguous across the
+  crash, arrival clocks/TTLs preserved via the journal's wall clock;
+- opts flags/env fallbacks/validators, serve_report's journal rows +
+  exit-1 gates, fleet_report's journal coverage cross-check.
+
+The real-subprocess SIGKILL-the-SUPERVISOR drill
+(``scripts/serve_supervisor.py --journal_probe``) is marked ``slow``
+and runs via ``make journal-chaos``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cst_captioning_tpu.serving.journal import (
+    JOURNAL_SCHEMA,
+    IntakeJournal,
+    _encode,
+    list_segments,
+    scan_dir,
+)
+
+from test_supervisor import (  # noqa: F401  (same-dir test harness)
+    REPO,
+    FakeChild,
+    FakeClock,
+    _run_report,
+    _sup_record,
+    build_sup,
+    tick_until,
+)
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(monkeypatch, tmp_path):
+    """Sanitizer-armed like the supervisor slice: the journal's one
+    declared lock (serving.journal.state) is re-validated against the
+    LOCK_ORDER under every drill in this file."""
+    from cst_captioning_tpu.analysis import locksan
+
+    receipt = tmp_path / "locksan_violation.json"
+    monkeypatch.setenv(locksan.ENV_FLAG, "1")
+    monkeypatch.setenv(locksan.ENV_RECEIPT, str(receipt))
+    before = len(locksan.violations())
+    yield
+    after = locksan.violations()
+    assert len(after) == before, f"lock-order violations: {after[before:]}"
+    assert not receipt.exists(), (
+        f"lock sanitizer receipt from a child process: "
+        f"{receipt.read_text()}")
+
+
+# -- write/scan round-trip -------------------------------------------------
+
+
+def _storm(j):
+    """One deterministic record mix: k0 terminal, k1 open with a mark,
+    k2 terminal (the FINAL record in the segment)."""
+    j.accept("k0", "c0", "v0", stream=False, ttl_ms=None, no_cache=False,
+             arrival_wall=500.0)
+    j.terminal("k0", {"id": "c0", "video_id": "v0",
+                      "caption": FakeChild.caption_for("v0")})
+    j.accept("k1", "c1", "v1", stream=True, ttl_ms=60000.0,
+             no_cache=False, arrival_wall=501.0)
+    j.mark("k1", 0, [11, 12], "w11 w12", 2)
+    j.accept("k2", "c2", "v2", stream=False, ttl_ms=None, no_cache=False,
+             arrival_wall=502.0)
+    j.terminal("k2", _TAIL_RESP)
+
+
+#: The exact final record _storm appends — byte length computed at
+#: collection time so the torn-tail sweep can parametrize over every
+#: byte boundary of it (the encoding is canonical: sorted keys,
+#: schema-stamped, checksum-framed, newline-terminated).
+_TAIL_RESP = {"id": "c2", "video_id": "v2",
+              "caption": FakeChild.caption_for("v2")}
+_TAIL_REC = {"kind": "term", "key": "k2", "resp": dict(_TAIL_RESP),
+             "schema": JOURNAL_SCHEMA}
+_TAIL_BYTES = _encode(_TAIL_REC)
+
+
+def test_roundtrip_survives_close_and_reopen(tmp_path):
+    root = str(tmp_path / "journal")
+    j1 = IntakeJournal(root)
+    _storm(j1)
+    hw = j1.high_water()
+    assert hw["segment"] == "seg-00000001.wal"
+    assert hw["offset"] == os.path.getsize(os.path.join(root,
+                                                        hw["segment"]))
+    st = j1.stats()
+    assert st["appends"] == st["fsyncs"] == 6
+    assert st["open"] == 1 and st["terminals"] == 2
+    j1.close()
+
+    j2 = IntakeJournal(root)
+    rec = j2.recovery
+    assert set(rec.accepts) == {"k0", "k1", "k2"}
+    assert set(rec.terminals) == {"k0", "k2"}
+    assert [m["seq"] for m in rec.marks["k1"]] == [0]
+    assert rec.torn_records == 0
+    # The open request carries everything replay needs, verbatim.
+    (open_req,) = j2.open_requests()
+    assert open_req["key"] == "k1" and open_req["stream"] is True
+    assert open_req["ttl_ms"] == 60000.0
+    assert open_req["arrival_wall"] == 501.0
+    # Recovered terminals answer duplicates with zero decode.
+    assert j2.terminal_for("k0")["caption"] == FakeChild.caption_for("v0")
+    assert j2.terminal_for("k1") is None
+    # Every open starts a FRESH segment: the crash evidence is frozen.
+    assert j2.high_water()["segment"] == "seg-00000002.wal"
+    assert j2.stats()["recovered_open"] == 1
+    assert j2.stats()["recovered_terminals"] == 2
+    j2.close()
+
+
+def test_scan_dir_is_read_only(tmp_path):
+    root = str(tmp_path / "journal")
+    j = IntakeJournal(root)
+    _storm(j)
+    j.close()
+    before = sorted(os.listdir(root))
+    rec = scan_dir(root)
+    assert sorted(os.listdir(root)) == before   # no new segment
+    assert set(rec.terminals) == {"k0", "k2"}
+    assert rec.segments_scanned == 1
+    assert scan_dir(str(tmp_path / "nowhere")).records == 0
+
+
+# -- THE torn-tail sweep ---------------------------------------------------
+
+
+@pytest.mark.parametrize("keep", range(len(_TAIL_BYTES)))
+def test_torn_tail_at_every_byte_boundary(tmp_path, keep):
+    """Truncate the segment mid-way through its FINAL record at every
+    byte boundary: the torn record is dropped (counted honestly), every
+    SEALED record survives exactly once, and the scan never crashes.
+    ``keep=0`` is the clean-cut case — the file ends at the previous
+    record's newline, so nothing is torn at all."""
+    root = str(tmp_path / "journal")
+    j = IntakeJournal(root)
+    _storm(j)
+    j.close()
+    seg = os.path.join(root, "seg-00000001.wal")
+    with open(seg, "rb") as f:
+        data = f.read()
+    # Sanity: the on-disk tail is byte-for-byte the record this sweep
+    # was parametrized against (guards the sweep against encode drift).
+    assert data.endswith(_TAIL_BYTES)
+    with open(seg, "r+b") as f:
+        f.truncate(len(data) - len(_TAIL_BYTES) + keep)
+
+    rec = scan_dir(root)
+    # Sealed records: never dropped, never double-applied.
+    assert set(rec.accepts) == {"k0", "k1", "k2"}
+    assert set(rec.terminals) == {"k0"}      # k2's terminal was torn
+    assert [m["seq"] for m in rec.marks["k1"]] == [0]
+    assert rec.records == 5
+    assert rec.torn_records == (0 if keep == 0 else 1)
+    assert {r["key"] for r in rec.open_requests()} == {"k1", "k2"}
+
+    # A journal reopened over the torn dir recovers identically and
+    # appends into a FRESH segment — never after the torn bytes.
+    j2 = IntakeJournal(root)
+    assert j2.stats()["torn_records"] == rec.torn_records
+    assert j2.is_open("k2")
+    j2.terminal("k2", _TAIL_RESP)            # re-answer lands sealed
+    j2.close()
+    assert os.path.getsize(seg) == len(data) - len(_TAIL_BYTES) + keep
+    assert set(scan_dir(root).terminals) == {"k0", "k2"}
+
+
+# -- rotation + compaction -------------------------------------------------
+
+
+def test_rotation_compacts_and_bounds_disk(tmp_path):
+    root = str(tmp_path / "journal")
+    # segment_bytes=1: every append seals the segment and compacts.
+    j = IntakeJournal(root, segment_bytes=1, compact=True)
+    for i in range(6):
+        j.accept(f"k{i}", f"c{i}", f"v{i}", stream=False, ttl_ms=None,
+                 no_cache=False)
+        j.terminal(f"k{i}", {"id": f"c{i}", "video_id": f"v{i}",
+                             "caption": FakeChild.caption_for(f"v{i}")})
+    j.accept("kopen", "co", "v7", stream=True, ttl_ms=None,
+             no_cache=False)
+    j.mark("kopen", 0, [71, 72], "w71 w72", 2)
+    st = j.stats()
+    assert st["rotations"] >= 6 and st["compactions"] >= 6
+    j.close()
+    # Disk is bounded: one compact file + the few live segments after
+    # it — never the 14 segments the appends sealed.
+    names = list_segments(root)
+    assert len(names) <= 3 and names[0].startswith("compact-")
+    # ...and the compacted state is EXACTLY the recoverable state:
+    # terminals retired their accept/mark entries, the open request
+    # kept its accept + marks.
+    rec = scan_dir(root)
+    assert set(rec.terminals) == {f"k{i}" for i in range(6)}
+    assert set(rec.open_requests()[0]["key"]) <= set("kopen")
+    assert [m["tokens"] for m in rec.marks["kopen"]] == [[71, 72]]
+    assert rec.torn_records == 0
+
+    # Forensic mode: compaction off keeps every sealed segment.
+    root2 = str(tmp_path / "forensic")
+    j2 = IntakeJournal(root2, segment_bytes=1, compact=False)
+    for i in range(4):
+        j2.accept(f"k{i}", f"c{i}", f"v{i}", stream=False, ttl_ms=None,
+                  no_cache=False)
+    j2.close()
+    assert len(list_segments(root2)) == 5    # 4 sealed + the active
+    assert set(scan_dir(root2).accepts) == {f"k{i}" for i in range(4)}
+
+
+# -- duplicate-id suppression through the supervisor -----------------------
+
+
+def test_duplicate_terminal_answered_idempotent_zero_decode(tmp_path):
+    j = IntakeJournal(str(tmp_path / "journal"))
+    sup, children, _ = build_sup(tmp_path / "sup", 1, journal=j)
+    got = []
+    sup.submit("a", "v1", respond=got.append, idem="kA")
+    tick_until(sup, lambda: got)
+    assert got[-1]["caption"] == FakeChild.caption_for("v1")
+    jobs_before = len(children[0].sent)
+    reqs_before = sup.supervisor_counters()["sup_requests"]
+
+    dup = []
+    sup.submit("b", "v1", respond=dup.append, idem="kA")
+    # Answered synchronously from the record: the id is the
+    # RESUBMITTER's, the caption the journaled terminal's, and no
+    # child saw any work — zero decode, sup_requests untouched.
+    assert dup[-1]["id"] == "b" and dup[-1]["idempotent"] is True
+    assert dup[-1]["caption"] == FakeChild.caption_for("v1")
+    assert len(children[0].sent) == jobs_before
+    c = sup.supervisor_counters()
+    assert c["sup_requests"] == reqs_before
+    assert c["sup_journal_dup_hits"] == 1
+
+    # No idem field -> the "<id>|<video_id>" default key dedupes too.
+    got2, dup2 = [], []
+    sup.submit("c", "v2", respond=got2.append)
+    tick_until(sup, lambda: got2)
+    sup.submit("c", "v2", respond=dup2.append)
+    assert dup2[-1]["idempotent"] is True
+    assert sup.supervisor_counters()["sup_journal_dup_hits"] == 2
+
+
+def test_duplicate_open_stream_attaches_and_catches_up(tmp_path):
+    j = IntakeJournal(str(tmp_path / "journal"))
+    sup, children, _ = build_sup(tmp_path / "sup", 1, journal=j)
+    got1, got2 = [], []
+    sup.submit("a", "v1", respond=got1.append, stream=True, idem="kS")
+    sup.tick()
+    sup.tick()                        # chunks seq 0, 1 to channel 1
+    assert [o["seq"] for o in got1] == [0, 1]
+
+    # A reconnect with no have_seq is caught up from ALL journaled
+    # marks, synchronously, then adopts the live tail.
+    sup.submit("a2", "v1", respond=got2.append, stream=True, idem="kS")
+    assert [o["seq"] for o in got2] == [0, 1]
+    assert got2[0]["tokens"] == FakeChild.tokens_for("v1")[:2]
+    assert sup.supervisor_counters()["sup_journal_attached"] == 1
+    n1 = len(got1)
+    tick_until(sup, lambda: any(o.get("final") for o in got2))
+    assert len(got1) == n1            # the old channel went quiet
+    fin = got2[-1]
+    assert fin["caption"] == FakeChild.caption_for("v1")
+    toks = [t for o in got2 if not o.get("final") for t in o["tokens"]]
+    assert toks == FakeChild.tokens_for("v1")   # every token ONCE
+
+    # A reconnect that already HAS seq<=floor only gets the marks past
+    # its watermark.
+    got3, got4 = [], []
+    sup.submit("b", "v3", respond=got3.append, stream=True, idem="kT")
+    sup.tick()
+    sup.tick()
+    sup.submit("b2", "v3", respond=got4.append, stream=True, idem="kT",
+               have_seq=0)
+    assert [o["seq"] for o in got4] == [1]
+
+
+# -- the in-process supervisor-death drill ---------------------------------
+
+
+def test_supervisor_death_replay_exactly_once_prefix_consistent(tmp_path):
+    """SIGKILL analogue: abandon supervisor+journal WITHOUT drain or
+    close mid-stream (every journal append was fsync'd, so the on-disk
+    state is exactly what a SIGKILL leaves), rebuild on the same dir,
+    replay, reattach — exactly-once, bit-identical, prefix-consistent,
+    arrival clocks rebased through the journal's wall clock."""
+    jdir = str(tmp_path / "journal")
+    wall = FakeClock(500.0)
+    j1 = IntakeJournal(jdir, wall=wall)
+    sup1, _, _ = build_sup(tmp_path / "a", 2, journal=j1)
+    pre = {}
+    # One request runs to terminal BEFORE the death...
+    done = []
+    sup1.submit("q0", "v0", respond=done.append, stream=True, idem="k0")
+    tick_until(sup1, lambda: any(o.get("final") for o in done))
+    # ...then a storm of streams gets exactly 2 chunks each and DIES.
+    for i in (1, 2, 3):
+        pre[i] = []
+        sup1.submit(f"q{i}", f"v{i}", respond=pre[i].append, stream=True,
+                    idem=f"k{i}",
+                    deadline_ms=(60000.0 if i == 1 else None))
+    sup1.tick()
+    sup1.tick()
+    for i in (1, 2, 3):
+        assert [o["seq"] for o in pre[i]] == [0, 1]
+    # No drain, no close: sup1/j1 are simply never touched again.
+
+    wall.advance(30.0)                       # 30s of process death
+    clock2 = FakeClock(200.0)
+    j2 = IntakeJournal(jdir, wall=wall)
+    sup2, children2, _ = build_sup(tmp_path / "b", 2, journal=j2,
+                                   clock=clock2)
+    ledger = sup2.replay_journal()
+    assert ledger["enabled"] and ledger["torn_records"] == 0
+    assert {r["key"] for r in ledger["replayed"]} == {"k1", "k2", "k3"}
+    assert ledger["recovered_terminals"] == 1
+    for r in ledger["replayed"]:             # watermark primed from
+        assert r["seq_out"] == 2 and r["sent_tokens"] == 4   # the marks
+    c = sup2.supervisor_counters()
+    assert c["sup_journal_replayed"] == 3 and c["sup_journal_torn"] == 0
+    # Arrival rebased into THIS incarnation's clock domain: the 30s the
+    # process was dead counts against the TTL, which itself survives.
+    pr1 = sup2._inflight_keys["k1"]
+    assert pr1.arrival == pytest.approx(clock2() - 30.0)
+    assert pr1.ttl_ms == 60000.0
+
+    # Clients resubmit the SAME ids/keys, holding seqs 0-1 already.
+    post = {}
+    for i in (1, 2, 3):
+        post[i] = []
+        sup2.submit(f"q{i}", f"v{i}", respond=post[i].append,
+                    stream=True, idem=f"k{i}", have_seq=1)
+    assert sup2.supervisor_counters()["sup_journal_attached"] == 3
+    tick_until(sup2, lambda: all(
+        any(o.get("final") for o in post[i]) for i in (1, 2, 3)))
+
+    for i in (1, 2, 3):
+        vid = f"v{i}"
+        both = pre[i] + post[i]
+        finals = [o for o in both if o.get("final")]
+        # Exactly once, bit-identical to the fault-free caption.
+        assert len(finals) == 1
+        assert finals[0]["caption"] == FakeChild.caption_for(vid)
+        assert "idempotent" not in finals[0]
+        # Prefix-consistent across the crash: seqs contiguous, every
+        # token exactly once, the continuation starting precisely at
+        # the journaled watermark.
+        chunks = [o for o in both if not o.get("final")]
+        assert [o["seq"] for o in chunks] == [0, 1, 2]
+        toks = [t for o in chunks for t in o["tokens"]]
+        assert toks == FakeChild.tokens_for(vid)
+        assert post[i][0]["tokens"] == FakeChild.tokens_for(vid)[4:6]
+
+    # The pre-death terminal answers its duplicate from the record.
+    dup = []
+    sup2.submit("q0", "v0", respond=dup.append, stream=True, idem="k0")
+    assert dup[-1]["idempotent"] is True
+    assert dup[-1]["caption"] == FakeChild.caption_for("v0")
+    assert sup2.supervisor_counters()["sup_journal_dup_hits"] == 1
+    assert not any(c.jobs for c in children2)
+    # Ledger accounting: replayed + recovered == every accepted key,
+    # and nothing is left open once the storm drains.
+    assert len(ledger["replayed"]) + ledger["recovered_terminals"] == 4
+    st = j2.stats()
+    assert st["open"] == 0 and st["recovered_open"] == 3
+    assert ledger["high_water"]["segment"] == "seg-00000002.wal"
+    j2.close()
+
+
+# -- opts ------------------------------------------------------------------
+
+
+def test_journal_flags_env_fallback_and_validation(monkeypatch):
+    from cst_captioning_tpu.opts import parse_opts
+
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.journal_dir is None            # conftest blanks the envs
+    assert ns.journal_segment_bytes == 1048576
+    assert ns.journal_compact == 1
+
+    monkeypatch.setenv("CST_JOURNAL_DIR", "/tmp/j")
+    monkeypatch.setenv("CST_JOURNAL_SEGMENT_BYTES", "4096")
+    monkeypatch.setenv("CST_JOURNAL_COMPACT", "0")
+    ns = parse_opts(["--serve_demo", "1"])
+    assert ns.journal_dir == "/tmp/j"
+    assert ns.journal_segment_bytes == 4096
+    assert ns.journal_compact == 0
+    # Explicit flag beats the environment.
+    ns = parse_opts(["--serve_demo", "1", "--journal_dir", "/tmp/k",
+                     "--journal_segment_bytes", "512"])
+    assert ns.journal_dir == "/tmp/k"
+    assert ns.journal_segment_bytes == 512
+
+    with pytest.raises(SystemExit):
+        parse_opts(["--journal_segment_bytes", "0"])    # needs >= 1
+    with pytest.raises(SystemExit):
+        parse_opts(["--journal_compact", "-1"])         # needs >= 0
+    monkeypatch.setenv("CST_JOURNAL_SEGMENT_BYTES", "-5")
+    with pytest.raises(SystemExit):
+        parse_opts(["--serve_demo", "1"])   # env values validated too
+
+
+# -- serve_report ----------------------------------------------------------
+
+
+def _journal_record(**over):
+    rec = _sup_record()
+    rec["journal"] = {
+        "enabled": True, "dir": "/tmp/j/journal",
+        "killed_mid_storm": True, "terminals_before_kill": 2,
+        "streams_in_flight_at_kill": 4, "replayed": 10,
+        "recovered_terminals": 2, "replay_accounted": True,
+        "exactly_once": True, "idempotent_answers": 2,
+        "dup_suppressed": True, "dup_hits": 3, "attached": 10,
+        "torn_records": 1, "torn_ok": True, "segments_scanned": 2,
+        "high_water": {"segment": "seg-00000002.wal", "offset": 4096},
+        "open_at_exit": 0, "relaunch_rc": 75, "clean_exit": True,
+    }
+    rec["journal"].update(over)
+    return rec
+
+
+def test_serve_report_renders_journal_rows(tmp_path):
+    proc = _run_report(_journal_record(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    for row in ("journal drill", "journal replay",
+                "journal exactly-once", "journal torn tail"):
+        assert row in proc.stdout
+    assert "killed_mid_storm=True" in proc.stdout
+    assert "seg-00000002.wal@4096" in proc.stdout
+
+
+def test_serve_report_gates_on_replay_accounting(tmp_path):
+    for over in ({"replay_accounted": False}, {"exactly_once": False},
+                 {"clean_exit": False}):
+        proc = _run_report(_journal_record(**over), tmp_path)
+        assert proc.returncode == 1, over
+        assert "journal replay accounting broken" in proc.stderr, over
+
+
+def test_serve_report_gates_on_dup_suppression(tmp_path):
+    proc = _run_report(_journal_record(dup_suppressed=False), tmp_path)
+    assert proc.returncode == 1
+    assert "duplicate-id suppression broken" in proc.stderr
+
+
+def test_serve_report_gates_on_torn_tail_and_mid_storm(tmp_path):
+    for over in ({"torn_ok": False}, {"killed_mid_storm": False}):
+        proc = _run_report(_journal_record(**over), tmp_path)
+        assert proc.returncode == 1, over
+        assert "torn-tail recovery broken" in proc.stderr, over
+
+
+def test_serve_report_journal_free_records_unchanged(tmp_path):
+    proc = _run_report(_sup_record(), tmp_path)
+    assert proc.returncode == 0, proc.stderr
+    assert "journal" not in proc.stdout
+
+
+# -- fleet_report coverage cross-check -------------------------------------
+
+
+def _fleet_sample(seq, wall):
+    return {
+        "schema": 1, "kind": "fleet_sample", "seq": seq, "t": wall,
+        "wall": wall, "interval_ms": 1000.0,
+        "fleet": {"replicas": 2, "in_service": 2, "outstanding": 0,
+                  "parked": 0, "completed": 5 * seq,
+                  "latency_p50_ms": 4.0, "latency_p99_ms": 9.0},
+        "children": [
+            {"index": k, "state": "ok", "live": True, "restarts": 0,
+             "inflight": 0, "queue_depth": 0, "latency_p50_ms": 4.0,
+             "latency_p99_ms": 9.0, "compiles": 2} for k in range(2)],
+    }
+
+
+def _fleet_rig(tmp_path, *, answer=("k0", "k1"), hw_lie=0):
+    """A run dir with healthy fleet samples, a real journal, and an
+    exit snapshot whose high-water mark can be made to LIE by
+    ``hw_lie`` bytes (claiming more durable bytes than exist)."""
+    root = tmp_path / "run"
+    root.mkdir()
+    with open(root / "fleet_metrics.jsonl", "w") as f:
+        for k in range(4):
+            f.write(json.dumps(_fleet_sample(k + 1, 100.0 + k)) + "\n")
+    j = IntakeJournal(str(root / "journal"))
+    for key in ("k0", "k1"):
+        j.accept(key, key, "v1", stream=False, ttl_ms=None,
+                 no_cache=False)
+    for key in answer:
+        j.terminal(key, {"id": key, "caption": "w11"})
+    stats = j.stats()
+    j.close()
+    stats["high_water"]["offset"] += hw_lie
+    with open(root / "supervisor_exit.json", "w") as f:
+        json.dump({"schema": 1, "journal": stats}, f)
+    return root
+
+
+def _run_fleet_report(root):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_report.py"),
+         "--dir", str(root)], capture_output=True, text=True, cwd=REPO)
+
+
+def test_fleet_report_journal_coverage_clean(tmp_path):
+    proc = _run_fleet_report(_fleet_rig(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    assert "journal" in proc.stdout
+    assert "2 accept(s) / 2 terminal(s)" in proc.stdout
+
+
+def test_fleet_report_gates_on_journal_coverage_hole(tmp_path):
+    proc = _run_fleet_report(_fleet_rig(tmp_path, answer=("k0",)))
+    assert proc.returncode == 1
+    assert "journal coverage hole" in proc.stderr
+    assert "k1" in proc.stderr                # the vanished id, named
+
+
+def test_fleet_report_gates_on_high_water_truncation(tmp_path):
+    proc = _run_fleet_report(_fleet_rig(tmp_path, hw_lie=64))
+    assert proc.returncode == 1
+    assert "journal high-water truncated" in proc.stderr
+
+
+def test_fleet_report_journal_free_runs_untouched(tmp_path):
+    root = _fleet_rig(tmp_path)
+    os.remove(root / "supervisor_exit.json")
+    proc = _run_fleet_report(root)
+    assert proc.returncode == 0, proc.stderr
+    assert "journal" not in proc.stdout
+
+
+# -- slow: the real-subprocess drill ---------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_journal_probe_sigkill_supervisor_end_to_end(tmp_path):
+    """THE acceptance drill through the real CLI: SIGKILL the
+    SUPERVISOR (whole process group) mid-storm with streams in flight,
+    relaunch on the same journal dir — every accepted request answered
+    exactly once, captions bit-identical to the fault-free
+    single-engine twin, stream prefixes consistent across the crash,
+    the duplicate id answered from the journal, zero post-warmup
+    compiles, and the record survives serve_report's gates."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = str(tmp_path / "supervise")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_supervisor.py"),
+         "--serve_demo", "1", "--journal_probe", "1",
+         "--supervise_replicas", "2", "--serve_demo_eos_bias", "-2",
+         "--decode_chunk", "2", "--beam_size", "1",
+         "--slo_p99_ms", "60000", "--slo_availability", "0.5",
+         "--supervise_dir", root],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    jn = rec["journal"]
+    assert jn["killed_mid_storm"] and jn["streams_in_flight_at_kill"] >= 1
+    assert jn["exactly_once"] and jn["replay_accounted"]
+    assert jn["dup_suppressed"] and jn["torn_ok"]
+    assert jn["clean_exit"] and jn["open_at_exit"] == 0
+    assert rec["completed"] == rec["num_requests"]
+    assert rec["supervisor"]["parity_ok"]
+    assert rec["recompiles_after_warmup"] == 0
+    assert rec["stream"]["prefix_ok"]
+    assert os.path.exists(os.path.join(root, "recovery_ledger.json"))
+    assert os.path.exists(os.path.join(root, "supervisor_exit.json"))
+    with open(os.path.join(root, "supervisor_exit.json")) as f:
+        assert "journal" in json.load(f)
+    report = _run_report(rec, tmp_path)
+    assert report.returncode == 0, report.stderr

@@ -28,6 +28,15 @@ class TestRealModelMesh:
         r1 = run_dp_pipeline(1, batch_size=B, xe_steps=2)
         r8 = run_dp_pipeline(8, batch_size=B, xe_steps=2)
         assert r8["mesh_shape"]["data"] == 8
+        # The 0.0-garble hardening (RESILIENCE.md caveat): the pipeline
+        # retries deterministically through resilience/garble.all_zero
+        # and SURFACES how many retries the result cost — assert the
+        # ladder stayed within its bound instead of trusting stdout.
+        # A clean attempt reports 0; a garbled machine reports 1-2 and
+        # the equivalence asserts below still hold because retries are
+        # bit-deterministic re-runs.
+        for r in (r1, r8):
+            assert 0 <= r["garble_retries"] <= 2, r["garble_retries"]
         np.testing.assert_allclose(r1["xe_losses"], r8["xe_losses"], rtol=1e-5)
         # The rollout is a deterministic function of (params, feats, key) in
         # the global view — sharding must not change which tokens come out.
